@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (multi-node behavior is likewise
+tested with in-process fakes, following the reference's DiscoveryServiceMock
+strategy — pkg/taskhandler/cluster_test.go:12-49)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_model_store(tmp_path):
+    """A provider base dir with a fabricated versioned model layout
+    (reference test fixture style, diskmodelprovider_test.go:13-31)."""
+    store = tmp_path / "store"
+    store.mkdir()
+    return store

@@ -1,0 +1,44 @@
+import textwrap
+
+from tfservingcache_tpu.config import Config, load_config
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.proxy.rest_port == 8093 and cfg.cache_node.grpc_port == 8095
+    assert cfg.discovery.type == ""  # single-node cache-only mode by default
+
+
+def test_yaml_and_env_override(tmp_path, monkeypatch):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        textwrap.dedent(
+            """
+            cache:
+              disk_capacity_bytes: 12345
+            model_provider:
+              type: disk
+              base_dir: /models
+            proxy:
+              replicas_per_model: 3
+            discovery:
+              type: static
+              nodes: ["a:1:2", "b:3:4"]
+            """
+        )
+    )
+    # env beats yaml (reference cfg.go:15-17 viper env precedence)
+    monkeypatch.setenv("TPUSC_CACHE_DISK_CAPACITY_BYTES", "999")
+    monkeypatch.setenv("TPUSC_SERVING_WARMUP", "false")
+    cfg = load_config(str(p))
+    assert cfg.cache.disk_capacity_bytes == 999
+    assert cfg.serving.warmup is False
+    assert cfg.model_provider.base_dir == "/models"
+    assert cfg.proxy.replicas_per_model == 3
+    assert cfg.discovery.nodes == ["a:1:2", "b:3:4"]
+
+
+def test_missing_file_ok(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = load_config()
+    assert cfg.cache.base_dir  # defaults intact

@@ -1,0 +1,61 @@
+import os
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.types import Model, ModelId
+
+
+def write_artifact(cache: ModelDiskCache, mid: ModelId, nbytes: int) -> Model:
+    path = cache.model_path(mid)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "params.bin"), "wb") as f:
+        f.write(b"z" * nbytes)
+    return Model(identifier=mid, path=path, size_on_disk=nbytes)
+
+
+def test_eviction_deletes_tree(tmp_path):
+    cache = ModelDiskCache(str(tmp_path / "c"), capacity_bytes=250)
+    a, b, c = ModelId("a", 1), ModelId("b", 1), ModelId("c", 1)
+    for mid in (a, b):
+        cache.put(write_artifact(cache, mid, 100))
+    pa = cache.model_path(a)
+    cache.put(write_artifact(cache, c, 100))  # evicts a
+    assert not os.path.exists(pa)
+    assert cache.get(a) is None
+    assert cache.get(b) is not None and cache.get(c) is not None
+    assert cache.total_bytes == 200
+
+
+def test_out_of_band_deletion_detected(tmp_path):
+    cache = ModelDiskCache(str(tmp_path / "c"), capacity_bytes=1000)
+    mid = ModelId("m", 3)
+    cache.put(write_artifact(cache, mid, 10))
+    import shutil
+
+    shutil.rmtree(cache.model_path(mid))
+    assert cache.get(mid) is None  # double-check file existence (reference cachemanager.go:154-165)
+
+
+def test_recover_index_after_restart(tmp_path):
+    base = str(tmp_path / "c")
+    cache = ModelDiskCache(base, capacity_bytes=1000)
+    m1, m2 = ModelId("x", 1), ModelId("y", 2)
+    cache.put(write_artifact(cache, m1, 100))
+    cache.put(write_artifact(cache, m2, 200))
+    # "restart": new instance over the same dir
+    cache2 = ModelDiskCache(base, capacity_bytes=1000)
+    assert set(cache2.list_models()) == {m1, m2}
+    assert cache2.total_bytes == 300
+    got = cache2.get(m1)
+    assert got is not None and os.path.isdir(got.path)
+
+
+def test_replace_put_does_not_delete_new_artifact(tmp_path):
+    # Disk-tier replacement: same key, same path — the overwrite already
+    # happened in place; the replace-callback must not rmtree the new files.
+    cache = ModelDiskCache(str(tmp_path / "c"), capacity_bytes=1000)
+    mid = ModelId("m", 1)
+    cache.put(write_artifact(cache, mid, 10))
+    cache.put(write_artifact(cache, mid, 20))
+    got = cache.get(mid)
+    assert got is not None and os.path.exists(got.path)
+    assert cache.total_bytes == 20

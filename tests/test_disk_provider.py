@@ -1,0 +1,68 @@
+"""Disk provider tests (reference diskmodelprovider_test.go:13-87: correct
+version dir chosen among decoys; zero-padded version dirs)."""
+
+import os
+
+import pytest
+
+from tfservingcache_tpu.cache.disk_cache import dir_size_bytes
+from tfservingcache_tpu.cache.providers.base import ModelNotFoundError
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+
+
+def make_artifact(root, name, version_dir, payload=b"x" * 100):
+    d = root / name / version_dir
+    d.mkdir(parents=True)
+    (d / "model.json").write_bytes(b"{}")
+    (d / "params.bin").write_bytes(payload)
+    sub = d / "assets"
+    sub.mkdir()
+    (sub / "vocab.txt").write_bytes(b"hello")
+    return d
+
+
+def test_loads_correct_version_among_decoys(tmp_model_store, tmp_path):
+    make_artifact(tmp_model_store, "m", "1", b"v1")
+    make_artifact(tmp_model_store, "m", "2", b"v2")
+    make_artifact(tmp_model_store, "m", "30", b"v30")
+    (tmp_model_store / "m" / "notaversion").mkdir()
+    p = DiskModelProvider(str(tmp_model_store))
+    dest = tmp_path / "cache" / "m" / "2"
+    model = p.load_model("m", 2, str(dest))
+    assert model.identifier.name == "m" and model.identifier.version == 2
+    assert (dest / "params.bin").read_bytes() == b"v2"
+    assert (dest / "assets" / "vocab.txt").exists()
+
+
+def test_zero_padded_version_matches(tmp_model_store, tmp_path):
+    make_artifact(tmp_model_store, "m", "000000042", b"padded")
+    p = DiskModelProvider(str(tmp_model_store))
+    dest = tmp_path / "cache" / "m" / "42"
+    model = p.load_model("m", 42, str(dest))
+    assert model.identifier.version == 42
+    assert (dest / "params.bin").read_bytes() == b"padded"
+
+
+def test_missing_model_and_version(tmp_model_store, tmp_path):
+    make_artifact(tmp_model_store, "m", "1")
+    p = DiskModelProvider(str(tmp_model_store))
+    with pytest.raises(ModelNotFoundError):
+        p.load_model("nope", 1, str(tmp_path / "d1"))
+    with pytest.raises(ModelNotFoundError):
+        p.load_model("m", 9, str(tmp_path / "d2"))
+
+
+def test_model_size_is_recursive(tmp_model_store):
+    d = make_artifact(tmp_model_store, "m", "7", b"y" * 1000)
+    p = DiskModelProvider(str(tmp_model_store))
+    expected = sum(
+        os.path.getsize(os.path.join(r, f)) for r, _, fs in os.walk(d) for f in fs
+    )
+    assert p.model_size("m", 7) == expected == dir_size_bytes(str(d))
+    assert expected > 1000  # includes nested assets
+
+
+def test_check(tmp_model_store):
+    DiskModelProvider(str(tmp_model_store)).check()
+    with pytest.raises(Exception):
+        DiskModelProvider(str(tmp_model_store / "missing")).check()

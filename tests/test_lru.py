@@ -1,0 +1,126 @@
+"""LRU semantics tests, covering the reference's scenarios
+(pkg/cachemanager/lrucache_test.go:7-116: add/get, miss, sequential +
+non-sequential eviction order, variable-size eviction, byte accounting)
+plus the thread-safety and oversize behavior the rebuild adds."""
+
+import threading
+
+import pytest
+
+from tfservingcache_tpu.cache.lru import CapacityError, LRUCache
+
+
+def test_add_get_and_miss():
+    c = LRUCache(100)
+    c.put("a", 10, "A")
+    assert c.get("a") == "A"
+    assert c.get("nope") is None
+    assert c.total_bytes == 10
+    assert "a" in c and "nope" not in c
+
+
+def test_sequential_eviction_order():
+    evicted = []
+    c = LRUCache(30, on_evict=lambda k, e: evicted.append(k))
+    for i in range(3):
+        c.put(f"m{i}", 10, i)
+    c.put("m3", 10, 3)  # evicts m0
+    c.put("m4", 10, 4)  # evicts m1
+    assert evicted == ["m0", "m1"]
+    assert c.keys_mru_first() == ["m4", "m3", "m2"]
+
+
+def test_access_refreshes_recency():
+    evicted = []
+    c = LRUCache(30, on_evict=lambda k, e: evicted.append(k))
+    for i in range(3):
+        c.put(f"m{i}", 10, i)
+    c.get("m0")          # m0 becomes MRU; m1 is now LRU
+    c.put("m3", 10, 3)
+    assert evicted == ["m1"]
+
+
+def test_variable_size_eviction_and_accounting():
+    evicted = []
+    c = LRUCache(100, on_evict=lambda k, e: evicted.append(k))
+    c.put("small1", 20, 1)
+    c.put("small2", 20, 2)
+    c.put("big", 90, 3)  # needs 90 free -> evicts small1 and small2
+    assert evicted == ["small1", "small2"]
+    assert c.total_bytes == 90
+
+
+def test_replace_updates_bytes():
+    c = LRUCache(100)
+    c.put("a", 40, 1)
+    c.put("a", 10, 2)
+    assert c.total_bytes == 10
+    assert c.get("a") == 2
+
+
+def test_ensure_free_bytes():
+    evicted = []
+    c = LRUCache(100, on_evict=lambda k, e: evicted.append(k))
+    c.put("a", 50, 1)
+    c.put("b", 40, 2)
+    gone = c.ensure_free_bytes(30)
+    assert gone == ["a"] == evicted
+    assert c.total_bytes == 40
+
+
+def test_oversize_rejected():
+    c = LRUCache(100)
+    c.put("a", 50, 1)
+    with pytest.raises(CapacityError):
+        c.put("huge", 101, 2)
+    # existing entries untouched
+    assert c.get("a") == 1
+
+
+def test_max_items_cap():
+    c = LRUCache(10_000, max_items=2)
+    c.put("a", 1, 1)
+    c.put("b", 1, 2)
+    c.put("c", 1, 3)
+    assert "a" not in c and "b" in c and "c" in c
+
+
+def test_remove_with_and_without_callback():
+    evicted = []
+    c = LRUCache(100, on_evict=lambda k, e: evicted.append(k))
+    c.put("a", 10, 1)
+    c.put("b", 10, 2)
+    assert c.remove("a") == 1
+    assert evicted == []
+    c.remove("b", run_callback=True)
+    assert evicted == ["b"]
+    assert c.total_bytes == 0
+
+
+def test_thread_safety_smoke():
+    c = LRUCache(1000)
+
+    def worker(tid):
+        for i in range(200):
+            c.put(f"{tid}-{i}", 7, i)
+            c.get(f"{tid}-{i % 17}")
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total_bytes <= 1000
+    # byte accounting consistent with entries
+    assert c.total_bytes == sum(e.size_bytes for _, e in c.items_lru_first())
+
+
+def test_replace_runs_evict_callback_on_old_entry():
+    # HBM-tier semantics: replacing a key must release the old payload's
+    # resources (otherwise re-loads leak device memory).
+    freed = []
+    c = LRUCache(100, on_evict=lambda k, e: freed.append((k, e.payload)))
+    c.put("m", 10, "exe-v1")
+    c.put("m", 10, "exe-v2")
+    assert freed == [("m", "exe-v1")]
+    assert c.get("m") == "exe-v2"

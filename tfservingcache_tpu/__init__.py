@@ -1,0 +1,23 @@
+"""tfservingcache_tpu — a TPU-native multi-tenant model-serving cache.
+
+A ground-up JAX/XLA re-design of the capabilities of mKaloer/TFServingCache
+(reference layer map: /root/reference, see SURVEY.md):
+
+  - speaks the TensorFlow Serving predict protocol (REST + gRPC) so existing
+    clients work unmodified (reference pkg/tfservingproxy/);
+  - routes each (model, version) to TPU chip groups in a pod slice via a
+    consistent hash ring with configurable per-model replication
+    (reference pkg/taskhandler/cluster.go);
+  - on a cache miss JIT-fetches the model artifact from disk/S3/GCS/Azure,
+    compiles it with JAX/XLA and pins the executable + params in TPU HBM
+    under a byte-budgeted two-tier LRU (reference pkg/cachemanager/);
+  - replaces the reference's external TensorFlow Serving process (reference
+    pkg/cachemanager/servingcontroller.go) with an in-process JAX runtime —
+    the process boundary in the reference's hot path disappears.
+
+Nothing in this package is a translation of the reference's Go: the compute
+path is jit/pjit/shard_map over a jax.sharding.Mesh and Pallas kernels; the
+runtime around it is asyncio + a small C++ routing core.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from tfservingcache_tpu.cache.lru import LRUCache, LRUEntry
+
+__all__ = ["LRUCache", "LRUEntry"]

@@ -1,0 +1,151 @@
+"""Disk artifact cache: versioned model dirs under a byte-budgeted LRU.
+
+Reference equivalent: the LRUCache + ``BaseDir``/``ModelPath`` pathing in
+pkg/cachemanager/lrucache.go:11-38. Layout is the SavedModel convention the
+whole protocol assumes: ``<base_dir>/<name>/<version>/...``.
+
+Improvements over the reference (SURVEY.md §5 checkpoint/resume): the index
+is rebuilt from disk at startup (the reference loses the LRU index on
+restart while files persist, cachemanager.go:154-165), and eviction removes
+the actual joined directory tree.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Callable
+
+from tfservingcache_tpu.cache.lru import LRUCache, LRUEntry
+from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("disk_cache")
+
+
+def dir_size_bytes(path: str) -> int:
+    """Recursive size (the reference stats the directory inode only —
+    diskmodelprovider.go:71-83 — which under-counts; don't replicate)."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            fp = os.path.join(root, f)
+            try:
+                total += os.path.getsize(fp)
+            except OSError:
+                pass
+    return total
+
+
+class ModelDiskCache:
+    def __init__(
+        self,
+        base_dir: str,
+        capacity_bytes: int,
+        on_evict: Callable[[ModelId], None] | None = None,
+        recover: bool = True,
+    ) -> None:
+        self.base_dir = os.path.abspath(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self._user_on_evict = on_evict
+        self.lru: LRUCache[ModelId, Model] = LRUCache(capacity_bytes, self._evict)
+        if recover:
+            self._recover_index()
+
+    # -- paths --------------------------------------------------------------
+    def model_path(self, model_id: ModelId) -> str:
+        return os.path.join(self.base_dir, model_id.name, str(model_id.version))
+
+    # -- LRU facade ---------------------------------------------------------
+    def get(self, model_id: ModelId) -> Model | None:
+        model = self.lru.get(model_id)
+        if model is None:
+            return None
+        # Tolerate out-of-band deletion: index says cached but files are gone
+        # (reference double-check, cachemanager.go:154-165).
+        if not os.path.exists(model.path):
+            self.lru.remove(model_id)
+            return None
+        return model
+
+    def put(self, model: Model) -> list[ModelId]:
+        return self.lru.put(model.identifier, model.size_on_disk, model)
+
+    def ensure_free_bytes(self, n: int) -> list[ModelId]:
+        return self.lru.ensure_free_bytes(n)
+
+    def remove(self, model_id: ModelId) -> None:
+        self.lru.remove(model_id, run_callback=True)
+
+    def list_models(self) -> list[ModelId]:
+        return self.lru.keys_mru_first()
+
+    @property
+    def total_bytes(self) -> int:
+        return self.lru.total_bytes
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self.lru.capacity_bytes
+
+    # -- internals ----------------------------------------------------------
+    def _evict(self, model_id: ModelId, entry: LRUEntry[Model]) -> None:
+        if model_id in self.lru:
+            # Replacement put(): the key is resident again at the same path —
+            # the old artifact was already overwritten in place, nothing to free.
+            return
+        path = self.model_path(model_id)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        # prune now-empty model dir
+        parent = os.path.dirname(path)
+        try:
+            if os.path.isdir(parent) and not os.listdir(parent):
+                os.rmdir(parent)
+        except OSError:
+            pass
+        log.info("evicted %s from disk cache (%d bytes)", model_id, entry.size_bytes)
+        if self._user_on_evict is not None:
+            self._user_on_evict(model_id)
+
+    def _recover_index(self) -> None:
+        """Repopulate the LRU from artifacts already on disk (restart path)."""
+        found: list[tuple[float, ModelId, str, int]] = []
+        try:
+            names = os.listdir(self.base_dir)
+        except OSError:
+            return
+        for name in names:
+            model_dir = os.path.join(self.base_dir, name)
+            try:
+                versions = os.listdir(model_dir)
+            except (NotADirectoryError, OSError):
+                continue
+            for ver in versions:
+                vdir = os.path.join(model_dir, ver)
+                try:
+                    version = int(ver)
+                except ValueError:
+                    continue
+                try:
+                    if not os.path.isdir(vdir):
+                        continue
+                    found.append(
+                        (os.path.getmtime(vdir), ModelId(name, version), vdir, dir_size_bytes(vdir))
+                    )
+                except OSError:
+                    # vanished out-of-band between listdir and stat — skip it,
+                    # don't abort recovery of the remaining artifacts
+                    continue
+        # oldest first so mtime order becomes LRU order
+        for _mtime, mid, vdir, size in sorted(found):
+            try:
+                self.lru.put(mid, size, Model(identifier=mid, path=vdir, size_on_disk=size))
+            except Exception as e:
+                log.warning(
+                    "dropping recovered artifact %s (%d bytes) that no longer fits: %s",
+                    mid, size, e,
+                )
+                shutil.rmtree(vdir, ignore_errors=True)
+        if found:
+            log.info("recovered %d cached artifacts (%d bytes)", len(self.lru), self.total_bytes)

@@ -1,0 +1,41 @@
+from tfservingcache_tpu.cache.providers.base import ModelProvider, ProviderError
+
+__all__ = ["ModelProvider", "ProviderError", "create_provider"]
+
+
+def create_provider(cfg) -> "ModelProvider":
+    """Factory by config type (reference CreateModelProvider,
+    cmd/taskhandler/main.go:152-187)."""
+    from tfservingcache_tpu.config import ModelProviderConfig
+
+    assert isinstance(cfg, ModelProviderConfig)
+    t = cfg.type.lower()
+    try:
+        if t in ("disk", "diskprovider"):
+            from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+
+            return DiskModelProvider(cfg.base_dir)
+        if t in ("s3", "s3provider"):
+            from tfservingcache_tpu.cache.providers.s3 import S3ModelProvider
+
+            return S3ModelProvider(
+                bucket=cfg.bucket, base_path=cfg.base_path, region=cfg.region, endpoint=cfg.endpoint
+            )
+        if t in ("gcs", "gcsprovider"):
+            from tfservingcache_tpu.cache.providers.gcs import GCSModelProvider
+
+            return GCSModelProvider(bucket=cfg.bucket, base_path=cfg.base_path)
+        if t in ("azblob", "azblobprovider"):
+            from tfservingcache_tpu.cache.providers.azblob import AZBlobModelProvider
+
+            return AZBlobModelProvider(
+                account_name=cfg.account_name,
+                account_key=cfg.account_key,
+                container=cfg.container,
+                base_path=cfg.base_path,
+            )
+    except ImportError as e:
+        raise ProviderError(
+            f"model provider {cfg.type!r} is unavailable in this build: {e}"
+        ) from e
+    raise ValueError(f"unknown model provider type: {cfg.type!r}")

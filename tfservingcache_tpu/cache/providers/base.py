@@ -1,0 +1,34 @@
+"""Storage abstraction.
+
+Reference equivalent: the 3-method ``ModelProvider`` interface
+(pkg/cachemanager/modelprovider.go:3-7) — deliberately kept this narrow so
+fakes stay trivial (SURVEY.md §4 lesson).
+"""
+
+from __future__ import annotations
+
+import abc
+
+from tfservingcache_tpu.types import Model
+
+
+class ProviderError(Exception):
+    pass
+
+
+class ModelNotFoundError(ProviderError):
+    pass
+
+
+class ModelProvider(abc.ABC):
+    @abc.abstractmethod
+    def load_model(self, name: str, version: int, dest_dir: str) -> Model:
+        """Fetch ``<name>/<version>`` into ``dest_dir`` and return the Model."""
+
+    @abc.abstractmethod
+    def model_size(self, name: str, version: int) -> int:
+        """Size in bytes of the stored artifact (used for pre-eviction)."""
+
+    @abc.abstractmethod
+    def check(self) -> None:
+        """Health probe; raise ProviderError when the backing store is down."""

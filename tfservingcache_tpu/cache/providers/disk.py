@@ -1,0 +1,64 @@
+"""Disk model provider.
+
+Reference equivalent: pkg/cachemanager/modelproviders/diskmodelprovider/
+diskmodelprovider.go. Semantics kept: version directories match by numeric
+parse so ``000000042`` serves version 42 (diskmodelprovider.go:46-69).
+Fixed: ``model_size`` is the recursive tree size, not a dir stat
+(SURVEY.md §7 quirk list).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from tfservingcache_tpu.cache.disk_cache import dir_size_bytes
+from tfservingcache_tpu.cache.providers.base import (
+    ModelNotFoundError,
+    ModelProvider,
+    ProviderError,
+)
+from tfservingcache_tpu.types import Model, ModelId
+
+
+class DiskModelProvider(ModelProvider):
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = os.path.abspath(base_dir)
+
+    def _find_src_path(self, name: str, version: int) -> str:
+        """Numeric version-dir matching (reference findSrcPathForModel,
+        diskmodelprovider.go:46-69)."""
+        model_dir = os.path.join(self.base_dir, name)
+        if not os.path.isdir(model_dir):
+            raise ModelNotFoundError(f"model dir not found: {model_dir}")
+        for entry in sorted(os.listdir(model_dir)):
+            full = os.path.join(model_dir, entry)
+            if not os.path.isdir(full):
+                continue
+            try:
+                if int(entry) == version:
+                    return full
+            except ValueError:
+                continue
+        raise ModelNotFoundError(f"version {version} of model {name!r} not found in {model_dir}")
+
+    def load_model(self, name: str, version: int, dest_dir: str) -> Model:
+        src = self._find_src_path(name, version)
+        if os.path.exists(dest_dir):
+            shutil.rmtree(dest_dir)
+        os.makedirs(os.path.dirname(dest_dir), exist_ok=True)
+        shutil.copytree(src, dest_dir)
+        return Model(
+            identifier=ModelId(name, version),
+            path=dest_dir,
+            size_on_disk=dir_size_bytes(dest_dir),
+        )
+
+    def model_size(self, name: str, version: int) -> int:
+        return dir_size_bytes(self._find_src_path(name, version))
+
+    def check(self) -> None:
+        """The reference's disk provider is always-healthy
+        (diskmodelprovider.go:85-88); here at least require the root to exist."""
+        if not os.path.isdir(self.base_dir):
+            raise ProviderError(f"provider base dir missing: {self.base_dir}")

@@ -1,0 +1,48 @@
+"""``tpuserve`` CLI — entry point wiring (reference cmd/taskhandler/main.go:20-43).
+
+Grows with the build: ``serve`` starts the cache node (and the proxy/router
+when discovery is configured), ``export`` writes model artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tfservingcache_tpu.config import load_config
+from tfservingcache_tpu.utils.logging import get_logger, setup_logging
+
+log = get_logger("cli")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpuserve", description=__doc__)
+    parser.add_argument("--config", default=None, help="path to config.yaml")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("serve", help="run a cache node (+ proxy when discovery is configured)")
+    exp = sub.add_parser("export", help="export a model artifact to a provider dir")
+    exp.add_argument("model", help="model family name (see tfservingcache_tpu.models.registry)")
+    exp.add_argument("dest", help="destination dir (<base>/<name>/<version> is created)")
+    exp.add_argument("--name", default=None)
+    exp.add_argument("--version", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    cfg = load_config(args.config)
+    setup_logging(cfg.logging.level, cfg.logging.fmt)
+
+    if args.cmd == "serve":
+        from tfservingcache_tpu.server import run_server
+
+        run_server(cfg)
+        return 0
+    if args.cmd == "export":
+        from tfservingcache_tpu.models.registry import export_artifact
+
+        path = export_artifact(args.model, args.dest, name=args.name, version=args.version)
+        print(path)
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""Config system.
+
+Reference equivalent: cmd/taskhandler/cfg.go:10-62 (viper: ./config.yaml +
+``TFSC_``-prefixed env vars with ``.`` -> ``_`` mapping). Key design change
+noted in SURVEY.md §2 C2: the reference reads viper keys ad-hoc deep inside
+libraries; here the whole config is parsed once into typed dataclasses and
+injected, so every component is constructible in tests without global state.
+
+Env override: ``TPUSC_<KEY>`` where dots become underscores, e.g.
+``TPUSC_CACHE_DISK_CAPACITY_BYTES=1000`` overrides ``cache.disk_capacity_bytes``
+(mirrors reference cfg.go:15-17 semantics with the new prefix).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+ENV_PREFIX = "TPUSC_"
+
+
+@dataclass
+class ServingConfig:
+    """In-process JAX serving runtime (replaces reference's external TF Serving
+    block, config.yaml:29-37)."""
+
+    max_concurrent_models: int = 16        # models resident in HBM at once
+    hbm_capacity_bytes: int = 8 << 30      # HBM byte budget for pinned params
+    warmup: bool = True                    # run one predict to pin+compile on load
+    compile_cache_dir: str = ""            # persistent XLA compile cache ("" = off)
+    load_timeout_s: float = 30.0           # cold-load deadline (reference: 10s, main.go:122)
+    platform: str = ""                     # "" = default jax backend; "cpu" forces CPU
+    donate_on_evict: bool = True
+
+
+@dataclass
+class CacheConfig:
+    """Disk artifact cache (reference config.yaml:25-27)."""
+
+    base_dir: str = "/tmp/tpusc_models"
+    disk_capacity_bytes: int = 10 << 30
+
+
+@dataclass
+class ModelProviderConfig:
+    """Reference config.yaml:1-23."""
+
+    type: str = "disk"                 # disk | s3 | gcs | azblob
+    base_dir: str = "./models"         # disk provider root
+    # s3/gcs/azblob:
+    bucket: str = ""
+    base_path: str = ""
+    region: str = ""
+    endpoint: str = ""                 # custom endpoint (minio etc.)
+    account_name: str = ""             # azblob
+    account_key: str = ""
+    container: str = ""
+
+
+@dataclass
+class ProxyConfig:
+    """Router/front layer (reference config.yaml:38-43)."""
+
+    rest_port: int = 8093
+    grpc_port: int = 8100
+    replicas_per_model: int = 1
+    grpc_max_message_bytes: int = 16 << 20   # reference cachemanager.go:230-233
+
+
+@dataclass
+class CacheNodePorts:
+    rest_port: int = 8094
+    grpc_port: int = 8095
+
+
+@dataclass
+class DiscoveryConfig:
+    """Reference config.yaml:44-58 (serviceDiscovery.*)."""
+
+    type: str = ""                     # "" = single-node cache-only mode | static | file | consul | etcd | kubernetes
+    heartbeat_ttl_s: float = 5.0
+    service_name: str = "tpuserve-cache"
+    # static backend:
+    nodes: list[str] = field(default_factory=list)   # "host:restPort:grpcPort"
+    # file backend:
+    path: str = ""
+    poll_interval_s: float = 2.0
+    # consul/etcd/k8s endpoints:
+    address: str = ""                  # consul http addr or etcd grpc addr
+    namespace: str = ""                # k8s namespace ("" = from serviceaccount)
+    field_selector: str = ""           # k8s endpoints selector
+    prefer_localhost: bool = False     # reference etcd.go:162-166 outbound-IP fallback
+
+
+@dataclass
+class MeshConfig:
+    """TPU chip-group topology — new territory (SURVEY.md §2 parallelism
+    inventory: the reference has none). Models larger than one chip are
+    sharded over a chip group; the ring assigns models to groups."""
+
+    chips_per_group: int = 1           # chip-group size for sharded models
+    axis_names: tuple[str, ...] = ("data", "model")
+    data_parallel: int = 1
+
+
+@dataclass
+class MetricsConfig:
+    model_labels: bool = False         # per-model:version labels (reference cachemanager.go:251-258)
+    path: str = "/monitoring/prometheus/metrics"
+
+
+@dataclass
+class LoggingConfig:
+    level: str = "info"
+    fmt: str = "text"                  # text | json (reference cfg.go:28-61)
+
+
+@dataclass
+class Config:
+    serving: ServingConfig = field(default_factory=ServingConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    model_provider: ModelProviderConfig = field(default_factory=ModelProviderConfig)
+    proxy: ProxyConfig = field(default_factory=ProxyConfig)
+    cache_node: CacheNodePorts = field(default_factory=CacheNodePorts)
+    discovery: DiscoveryConfig = field(default_factory=DiscoveryConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+    # health probe model name (reference cfg.go:64-66 default)
+    health_probe_model: str = "__TPUSC_PROBE_CHECK__"
+
+
+def _coerce(value: str, target: Any) -> Any:
+    """Coerce an env-var string to the type of the dataclass default."""
+    if isinstance(target, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(target, int):
+        return int(value)
+    if isinstance(target, float):
+        return float(value)
+    if isinstance(target, (list, tuple)):
+        parts = [p for p in value.split(",") if p]
+        return type(target)(parts)
+    return value
+
+
+def _apply_mapping(cfg: Any, data: dict[str, Any], path: str = "") -> None:
+    for f in dataclasses.fields(cfg):
+        if f.name not in data:
+            continue
+        val = data[f.name]
+        cur = getattr(cfg, f.name)
+        if dataclasses.is_dataclass(cur):
+            if not isinstance(val, dict):
+                raise ValueError(
+                    f"config section {path}{f.name!s} must be a mapping, got {type(val).__name__}"
+                )
+            _apply_mapping(cur, val, f"{path}{f.name}.")
+        elif isinstance(val, str) and not isinstance(cur, str):
+            setattr(cfg, f.name, _coerce(val, cur))
+        elif isinstance(cur, tuple) and isinstance(val, list):
+            setattr(cfg, f.name, tuple(val))
+        else:
+            setattr(cfg, f.name, val)
+
+
+def _apply_env(cfg: Any, prefix: str) -> None:
+    for f in dataclasses.fields(cfg):
+        cur = getattr(cfg, f.name)
+        key = f"{prefix}{f.name.upper()}"
+        if dataclasses.is_dataclass(cur):
+            _apply_env(cur, f"{key}_")
+        elif key in os.environ:
+            try:
+                setattr(cfg, f.name, _coerce(os.environ[key], cur))
+            except ValueError as e:
+                raise ValueError(f"invalid value for env {key}: {e}") from e
+
+
+def load_config(path: str | None = None, env: bool = True) -> Config:
+    """Load ``config.yaml`` (if present) and apply ``TPUSC_*`` env overrides.
+
+    Mirrors reference cfg.go:10-27: missing file is fine (env/defaults only).
+    """
+    cfg = Config()
+    if path is None and os.path.exists("config.yaml"):
+        path = "config.yaml"
+    if path and os.path.exists(path):
+        with open(path) as fh:
+            data = yaml.safe_load(fh) or {}
+        _apply_mapping(cfg, data)
+    if env:
+        _apply_env(cfg, ENV_PREFIX)
+    return cfg

@@ -1,0 +1,70 @@
+"""Core shared types.
+
+Reference equivalents: ``ModelIdentifier``/``Model`` structs
+(pkg/cachemanager/cachemanager.go:45-54) and the routing key format
+``name + "##" + version`` (pkg/taskhandler/taskhandler.go:84-92).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, NamedTuple
+
+
+class ModelId(NamedTuple):
+    name: str
+    version: int
+
+    @property
+    def key(self) -> str:
+        """Consistent-hash routing key (reference taskhandler.go:87)."""
+        return f"{self.name}##{self.version}"
+
+    def __str__(self) -> str:
+        return f"{self.name}@{self.version}"
+
+
+@dataclass
+class Model:
+    """A fetched model artifact on local disk."""
+
+    identifier: ModelId
+    path: str = ""                 # absolute path of the artifact dir in the disk cache
+    size_on_disk: int = 0
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+
+class ModelState(enum.IntEnum):
+    """Model lifecycle state machine.
+
+    Mirrors TF Serving's ``ModelVersionStatus_State`` enum values 0/10/20/30/40/50
+    that the reference tracks via gRPC (pkg/cachemanager/servingcontroller.go:29-54);
+    here the state machine lives in-process in the JAX runtime.
+    """
+
+    UNKNOWN = 0
+    START = 10
+    LOADING = 20
+    AVAILABLE = 30
+    UNLOADING = 40
+    END = 50
+
+
+@dataclass
+class NodeInfo:
+    """A serving peer (reference ``ServingService``, pkg/taskhandler/cluster.go:16-20);
+    identity string is ``host:restPort:grpcPort`` (cluster.go:142-164)."""
+
+    host: str
+    rest_port: int
+    grpc_port: int
+
+    @property
+    def ident(self) -> str:
+        return f"{self.host}:{self.rest_port}:{self.grpc_port}"
+
+    @classmethod
+    def from_ident(cls, s: str) -> "NodeInfo":
+        host, rest, grpc = s.rsplit(":", 2)
+        return cls(host=host, rest_port=int(rest), grpc_port=int(grpc))

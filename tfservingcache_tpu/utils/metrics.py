@@ -1,0 +1,93 @@
+"""Prometheus metrics.
+
+Metric-name parity with the reference where the concept survives
+(pkg/tfservingproxy/tfservingproxy.go:25-32, pkg/cachemanager/cachemanager.go:24-43),
+plus TPU-native additions (compile time, HBM residency) that have no
+reference counterpart. Per-model labels are optional to bound cardinality
+(reference cachemanager.go:251-258 "all_models" fallback).
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+ALL_MODELS = "all_models"
+
+
+class Metrics:
+    """One instance per process; injected (no promauto-style globals so tests
+    can build many nodes in-process without collisions)."""
+
+    def __init__(self, model_labels: bool = False) -> None:
+        self.registry = CollectorRegistry()
+        self.model_labels = model_labels
+        r = self.registry
+        # L0 proxy counters (reference tfservingproxy.go:25-32) — and unlike the
+        # reference, the failure counter only counts failures (SURVEY.md §2 C3 bug).
+        self.request_count = Counter(
+            "tfservingcache_request_count", "Number of requests", ["protocol"], registry=r
+        )
+        self.request_failures = Counter(
+            "tfservingcache_request_fail_count", "Number of failed requests", ["protocol"], registry=r
+        )
+        # L3 cache counters/histograms (reference cachemanager.go:24-43)
+        self.cache_total = Counter(
+            "tfservingcache_cache_total_count", "Cache lookups", ["model"], registry=r
+        )
+        self.cache_hits = Counter(
+            "tfservingcache_cache_hit_count", "Cache hits", ["model"], registry=r
+        )
+        self.cache_misses = Counter(
+            "tfservingcache_cache_miss_count", "Cache misses", ["model"], registry=r
+        )
+        self.cache_duration = Histogram(
+            "tfservingcache_cache_duration_seconds",
+            "Total time spent ensuring a model is servable",
+            ["model"],
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60),
+        )
+        self.cache_fetch_duration = Histogram(
+            "tfservingcache_cache_fetch_duration_seconds",
+            "Time spent fetching model artifacts from the provider",
+            ["model"],
+            registry=r,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60),
+        )
+        # TPU-native additions (no reference counterpart)
+        self.compile_duration = Histogram(
+            "tpusc_compile_duration_seconds",
+            "XLA compile+warmup time per model load",
+            ["model"],
+            registry=r,
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120),
+        )
+        self.hbm_bytes_in_use = Gauge(
+            "tpusc_hbm_bytes_in_use", "Bytes of HBM pinned by resident models", registry=r
+        )
+        self.models_resident = Gauge(
+            "tpusc_models_resident", "Models currently AVAILABLE in the runtime", registry=r
+        )
+        self.disk_bytes_in_use = Gauge(
+            "tpusc_disk_cache_bytes_in_use", "Bytes used by the disk artifact cache", registry=r
+        )
+        self.evictions = Counter(
+            "tpusc_evictions_total", "Evictions", ["tier"], registry=r
+        )
+
+    def model_label(self, name: str, version: int | str) -> str:
+        if self.model_labels:
+            return f"{name}:{version}"
+        return ALL_MODELS
+
+    def render(self) -> bytes:
+        """Text exposition of this registry (served on the metrics path;
+        reference merges TF Serving's scrape here too — metrics.go:16-53 —
+        which disappears now that serving is in-process)."""
+        return generate_latest(self.registry)
