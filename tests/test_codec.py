@@ -1,0 +1,114 @@
+import ml_dtypes
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.protocol.codec import (
+    CodecError,
+    decode_predict_json,
+    encode_predict_json,
+    numpy_to_tensorproto,
+    tensorproto_to_numpy,
+)
+from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
+
+
+@pytest.mark.parametrize(
+    "arr",
+    [
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+        np.arange(4, dtype=np.int64),
+        np.array([[True, False]]),
+        np.array(3.5, dtype=np.float64),
+        np.arange(8, dtype=np.uint8).reshape(2, 2, 2),
+        np.array([1.5, -2.25], dtype=np.float16),
+        np.array([1.0, 2.0], dtype=ml_dtypes.bfloat16),
+    ],
+)
+def test_tensorproto_roundtrip(arr):
+    tp = numpy_to_tensorproto(arr)
+    back = tensorproto_to_numpy(tp)
+    assert back.dtype == arr.dtype and back.shape == arr.shape
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_tensorproto_string_roundtrip():
+    arr = np.array([b"hello", b"tpu"], dtype=object)
+    back = tensorproto_to_numpy(numpy_to_tensorproto(arr))
+    assert list(back) == [b"hello", b"tpu"]
+
+
+def test_val_field_decode_and_fill():
+    # clients commonly send repeated float_val instead of tensor_content
+    tp = core.TensorProto(dtype=core.DT_FLOAT)
+    tp.tensor_shape.dim.add(size=3)
+    tp.float_val.extend([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(tensorproto_to_numpy(tp), [1.0, 2.0, 3.0])
+    # single-value fill broadcast (TF MakeNdarray semantics)
+    tp2 = core.TensorProto(dtype=core.DT_INT32)
+    tp2.tensor_shape.dim.add(size=4)
+    tp2.int_val.append(7)
+    np.testing.assert_array_equal(tensorproto_to_numpy(tp2), [7, 7, 7, 7])
+
+
+def test_element_count_mismatch_rejected():
+    tp = core.TensorProto(dtype=core.DT_FLOAT)
+    tp.tensor_shape.dim.add(size=4)
+    tp.float_val.extend([1.0, 2.0])
+    with pytest.raises(CodecError):
+        tensorproto_to_numpy(tp)
+
+
+def test_json_row_single_input():
+    arrays, sig = decode_predict_json(
+        {"instances": [[1.0, 2.0], [3.0, 4.0]]}, {"x": np.dtype(np.float32)}
+    )
+    assert sig == "serving_default"
+    np.testing.assert_array_equal(arrays["x"], [[1.0, 2.0], [3.0, 4.0]])
+    assert arrays["x"].dtype == np.float32
+
+
+def test_json_row_named_inputs():
+    arrays, _ = decode_predict_json(
+        {"instances": [{"a": [1.0], "b": 2}, {"a": [3.0], "b": 4}]},
+        {"a": np.dtype(np.float32), "b": np.dtype(np.int32)},
+    )
+    np.testing.assert_array_equal(arrays["a"], [[1.0], [3.0]])
+    np.testing.assert_array_equal(arrays["b"], [2, 4])
+    assert arrays["b"].dtype == np.int32
+
+
+def test_json_columnar_and_signature():
+    arrays, sig = decode_predict_json(
+        {"signature_name": "other", "inputs": {"x": [[1, 2]]}}, {"x": np.dtype(np.float32)}
+    )
+    assert sig == "other"
+    np.testing.assert_array_equal(arrays["x"], [[1.0, 2.0]])
+
+
+def test_json_b64_bytes():
+    arrays, _ = decode_predict_json({"instances": [{"b64": "aGVsbG8="}]}, {})
+    assert arrays["inputs"][0] == b"hello"
+
+
+def test_json_both_keys_rejected():
+    with pytest.raises(CodecError):
+        decode_predict_json({"instances": [1], "inputs": [1]}, {})
+    with pytest.raises(CodecError):
+        decode_predict_json({}, {})
+
+
+def test_encode_row_and_columnar():
+    out = {"y": np.array([[1.0], [2.0]], dtype=np.float32)}
+    assert encode_predict_json(out, row_format=True) == {"predictions": [[1.0], [2.0]]}
+    assert encode_predict_json(out, row_format=False) == {"outputs": [[1.0], [2.0]]}
+    multi = {
+        "y": np.array([[1.0], [2.0]], dtype=np.float32),
+        "z": np.array([9, 8], dtype=np.int32),
+    }
+    row = encode_predict_json(multi, row_format=True)
+    assert row == {"predictions": [{"y": [1.0], "z": 9}, {"y": [2.0], "z": 8}]}
+
+
+def test_encode_bytes_b64():
+    out = {"y": np.array([b"ab"], dtype=object)}
+    assert encode_predict_json(out, row_format=True) == {"predictions": [{"b64": "YWI="}]}

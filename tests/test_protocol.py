@@ -1,0 +1,212 @@
+"""Protocol-layer e2e tests, in-process with an echo backend — the
+reference's mock-upstream technique (tfservingproxy_test.go:26-109) without
+fixed ports (servers bind port 0)."""
+
+import json
+from contextlib import asynccontextmanager
+
+import aiohttp
+import grpc
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
+from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
+from tfservingcache_tpu.protocol.grpc_server import (
+    MODEL_SERVICE,
+    PREDICTION_SERVICE,
+    GrpcServingServer,
+)
+from tfservingcache_tpu.protocol.rest import RestServingServer, parse_model_url
+from tfservingcache_tpu.protocol.protos import grpc_health_pb2 as health_pb
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+from tfservingcache_tpu.utils.metrics import Metrics
+
+
+class EchoBackend(ServingBackend):
+    """Echoes request facts back so tests can assert the full path."""
+
+    async def predict(self, request):
+        resp = sv.PredictResponse()
+        resp.model_spec.CopyFrom(request.model_spec)
+        for k, v in request.inputs.items():
+            resp.outputs[k].CopyFrom(v)
+        return resp
+
+    async def classify(self, request):
+        resp = sv.ClassificationResponse()
+        for _ in request.input.example_list.examples:
+            c = resp.result.classifications.add()
+            c.classes.add(label="echo", score=1.0)
+        return resp
+
+    async def regress(self, request):
+        resp = sv.RegressionResponse()
+        for _ in request.input.example_list.examples:
+            resp.result.regressions.add(value=0.5)
+        return resp
+
+    async def get_model_metadata(self, request):
+        resp = sv.GetModelMetadataResponse()
+        resp.model_spec.CopyFrom(request.model_spec)
+        return resp
+
+    async def session_run(self, request):
+        resp = sv.SessionRunResponse()
+        for f in request.feed:
+            t = resp.tensor.add()
+            t.CopyFrom(f)
+        return resp
+
+    async def get_model_status(self, request):
+        resp = sv.GetModelStatusResponse()
+        s = resp.model_version_status.add()
+        s.version = request.model_spec.version.value
+        s.state = sv.ModelVersionStatus.AVAILABLE
+        return resp
+
+    async def reload_config(self, request):
+        return sv.ReloadConfigResponse()
+
+    async def handle_rest(self, method, model_name, version, verb, body):
+        if model_name == "boom":
+            raise BackendError("kaput", grpc.StatusCode.NOT_FOUND, 404)
+        payload = {
+            "method": method,
+            "model": model_name,
+            "version": version,
+            "verb": verb,
+            "body_len": len(body),
+        }
+        return RestResponse(status=200, body=json.dumps(payload).encode())
+
+
+@asynccontextmanager
+async def serving_servers():
+    metrics = Metrics()
+    backend = EchoBackend()
+    g = GrpcServingServer(backend, metrics)
+    gport = await g.start(0, host="127.0.0.1")
+    r = RestServingServer(backend, metrics, metrics_path="/monitoring/prometheus/metrics")
+    rport = await r.start(0, host="127.0.0.1")
+    try:
+        yield g, gport, r, rport, metrics
+    finally:
+        await r.close()
+        await g.close()
+
+
+def test_parse_model_url_rules():
+    assert parse_model_url("/v1/models/m/versions/3:predict") == ("m", 3, "predict")
+    assert parse_model_url("/v1/models/m:predict") == ("m", None, "predict")
+    assert parse_model_url("/v1/models/m/versions/3") == ("m", 3, None)
+    assert parse_model_url("/v1/models/m") == ("m", None, None)
+    assert parse_model_url("/V1/MODELS/m/VERSIONS/3") == ("m", 3, None)  # case-insensitive
+    assert parse_model_url("/v1/models/m/versions/3/metadata") == ("m", 3, "metadata")
+    assert parse_model_url("/v2/nope") is None
+    assert parse_model_url("/v1/models/m:poke") is None
+    assert parse_model_url("/v1/models/m/versions/notanumber") is None
+
+
+async def test_rest_predict_roundtrip():
+    async with serving_servers() as (_, _, _, rport, _):
+        async with aiohttp.ClientSession() as s:
+            url = f"http://127.0.0.1:{rport}/v1/models/mymodel/versions/2:predict"
+            async with s.post(url, data=b'{"instances": [1]}') as resp:
+                assert resp.status == 200
+                data = await resp.json()
+        assert data == {
+            "method": "POST",
+            "model": "mymodel",
+            "version": 2,
+            "verb": "predict",
+            "body_len": 18,
+        }
+
+
+async def test_rest_404_and_400_contract():
+    async with serving_servers() as (_, _, _, rport, metrics):
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{rport}/v2/bogus") as resp:
+                assert resp.status == 404
+                assert await resp.json() == {"Status": "Error", "Message": "Not found"}
+            async with s.post(f"http://127.0.0.1:{rport}/v1/models/m:predict") as resp:
+                assert resp.status == 400
+                assert await resp.json() == {
+                    "Status": "Error",
+                    "Message": "Model version must be provided",
+                }
+            # backend error mapping
+            async with s.post(
+                f"http://127.0.0.1:{rport}/v1/models/boom/versions/1:predict"
+            ) as resp:
+                assert resp.status == 404
+        # failure counter counts only failures (reference bug fixed)
+        text = metrics.render().decode()
+        fail_lines = [
+            line
+            for line in text.splitlines()
+            if line.startswith("tfservingcache_proxy_failures_total{")
+        ]
+        assert fail_lines and all('protocol="rest"' in l for l in fail_lines)
+        assert sum(float(l.rsplit(" ", 1)[1]) for l in fail_lines) == 3.0
+
+
+async def test_rest_metrics_endpoint():
+    async with serving_servers() as (_, _, _, rport, _):
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/prometheus/metrics"
+            ) as resp:
+                assert resp.status == 200
+                body = await resp.text()
+        assert "tfservingcache_proxy_requests" in body
+
+
+async def test_grpc_predict_roundtrip():
+    async with serving_servers() as (_, gport, _, _, _):
+        channel = make_channel(f"127.0.0.1:{gport}")
+        stub = ServingStub(channel)
+        req = sv.PredictRequest()
+        req.model_spec.name = "m"
+        req.model_spec.version.value = 5
+        req.inputs["x"].dtype = 1
+        req.inputs["x"].tensor_shape.dim.add(size=2)
+        req.inputs["x"].float_val.extend([1.5, 2.5])
+        resp = await stub.method(PREDICTION_SERVICE, "Predict")(req)
+        assert resp.model_spec.name == "m" and resp.model_spec.version.value == 5
+        np.testing.assert_array_equal(list(resp.outputs["x"].float_val), [1.5, 2.5])
+        await channel.close()
+
+
+async def test_grpc_model_status_and_multiinference():
+    async with serving_servers() as (_, gport, _, _, _):
+        channel = make_channel(f"127.0.0.1:{gport}")
+        stub = ServingStub(channel)
+        req = sv.GetModelStatusRequest()
+        req.model_spec.name = "m"
+        req.model_spec.version.value = 9
+        resp = await stub.method(MODEL_SERVICE, "GetModelStatus")(req)
+        assert resp.model_version_status[0].version == 9
+        assert resp.model_version_status[0].state == sv.ModelVersionStatus.AVAILABLE
+        # MultiInference rejected (parity with reference tfservingproxy.go:215-217)
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await stub.method(PREDICTION_SERVICE, "MultiInference")(sv.MultiInferenceRequest())
+        assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        await channel.close()
+
+
+async def test_grpc_health():
+    async with serving_servers() as (g, gport, _, _, _):
+        channel = make_channel(f"127.0.0.1:{gport}")
+        check = channel.unary_unary(
+            "/grpc.health.v1.Health/Check",
+            request_serializer=health_pb.HealthCheckRequest.SerializeToString,
+            response_deserializer=health_pb.HealthCheckResponse.FromString,
+        )
+        resp = await check(health_pb.HealthCheckRequest())
+        assert resp.status == health_pb.HealthCheckResponse.NOT_SERVING
+        g.set_health(True)
+        resp = await check(health_pb.HealthCheckRequest())
+        assert resp.status == health_pb.HealthCheckResponse.SERVING
+        await channel.close()

@@ -14,7 +14,9 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import Callable
+import threading
+from contextlib import contextmanager
+from typing import Callable, Iterator
 
 from tfservingcache_tpu.cache.lru import LRUCache, LRUEntry
 from tfservingcache_tpu.types import Model, ModelId
@@ -49,8 +51,22 @@ class ModelDiskCache:
         os.makedirs(self.base_dir, exist_ok=True)
         self._user_on_evict = on_evict
         self.lru: LRUCache[ModelId, Model] = LRUCache(capacity_bytes, self._evict)
+        # Per-model mutexes shared by eviction and (re)load: a deferred evict
+        # rmtree must not race a concurrent re-fetch writing the same path.
+        self._key_locks: dict[ModelId, threading.Lock] = {}
+        self._key_locks_guard = threading.Lock()
         if recover:
             self._recover_index()
+
+    @contextmanager
+    def fetch_lock(self, model_id: ModelId) -> Iterator[None]:
+        """Hold while fetching/writing ``model_id``'s artifact dir. The evict
+        callback takes the same lock, so an in-flight eviction of a model that
+        is being re-loaded waits, then sees it resident again and skips."""
+        with self._key_locks_guard:
+            lock = self._key_locks.setdefault(model_id, threading.Lock())
+        with lock:
+            yield
 
     # -- paths --------------------------------------------------------------
     def model_path(self, model_id: ModelId) -> str:
@@ -90,20 +106,24 @@ class ModelDiskCache:
 
     # -- internals ----------------------------------------------------------
     def _evict(self, model_id: ModelId, entry: LRUEntry[Model]) -> None:
-        if model_id in self.lru:
-            # Replacement put(): the key is resident again at the same path —
-            # the old artifact was already overwritten in place, nothing to free.
-            return
-        path = self.model_path(model_id)
-        if os.path.isdir(path):
-            shutil.rmtree(path, ignore_errors=True)
-        # prune now-empty model dir
-        parent = os.path.dirname(path)
-        try:
-            if os.path.isdir(parent) and not os.listdir(parent):
-                os.rmdir(parent)
-        except OSError:
-            pass
+        with self._key_locks_guard:
+            lock = self._key_locks.setdefault(model_id, threading.Lock())
+        with lock:
+            if model_id in self.lru:
+                # The key is resident again: either a replacement put() (same
+                # path, overwritten in place) or a re-fetch that won the race
+                # against this deferred eviction. Nothing to free.
+                return
+            path = self.model_path(model_id)
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            # prune now-empty model dir
+            parent = os.path.dirname(path)
+            try:
+                if os.path.isdir(parent) and not os.listdir(parent):
+                    os.rmdir(parent)
+            except OSError:
+                pass
         log.info("evicted %s from disk cache (%d bytes)", model_id, entry.size_bytes)
         if self._user_on_evict is not None:
             self._user_on_evict(model_id)
@@ -123,6 +143,11 @@ class ModelDiskCache:
                 continue
             for ver in versions:
                 vdir = os.path.join(model_dir, ver)
+                if ".tmp-" in ver:
+                    # stray staging dir from a crash mid-fetch (providers write
+                    # to <ver>.tmp-<pid> then atomically rename)
+                    shutil.rmtree(vdir, ignore_errors=True)
+                    continue
                 try:
                     version = int(ver)
                 except ValueError:

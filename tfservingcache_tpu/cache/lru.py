@@ -122,9 +122,16 @@ class LRUCache(Generic[K, V]):
 
     def ensure_free_bytes(self, n: int) -> list[K]:
         """Evict LRU entries until at least ``n`` bytes are free
-        (reference EnsureFreeBytes, lrucache.go:68-87)."""
+        (reference EnsureFreeBytes, lrucache.go:68-87). Raises CapacityError
+        up front when ``n`` can never fit — draining the whole warm cache for
+        a load that must fail anyway would be strictly worse."""
+        n = int(n)
+        if n > self.capacity_bytes:
+            raise CapacityError(
+                f"requested {n}B free exceeds cache capacity {self.capacity_bytes}B"
+            )
         with self._lock:
-            evicted = self._evict_to_fit_locked(int(n), extra_items=0)
+            evicted = self._evict_to_fit_locked(n, extra_items=0)
         self._run_callbacks(evicted)
         return [k for k, _ in evicted]
 

@@ -8,8 +8,32 @@ fakes stay trivial (SURVEY.md §4 lesson).
 from __future__ import annotations
 
 import abc
+import os
+import shutil
+from contextlib import contextmanager
+from typing import Iterator
 
 from tfservingcache_tpu.types import Model
+
+
+@contextmanager
+def atomic_dest(dest_dir: str) -> Iterator[str]:
+    """Stage provider writes in ``<dest>.tmp-<pid>`` and atomically rename on
+    success, so a crash mid-fetch never leaves a half-written artifact at the
+    final path (a partial tree would be recovered as a complete model after
+    restart). All providers write through this."""
+    tmp = f"{dest_dir}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
+    try:
+        yield tmp
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if os.path.exists(dest_dir):
+        shutil.rmtree(dest_dir)
+    os.replace(tmp, dest_dir)
 
 
 class ProviderError(Exception):

@@ -17,6 +17,7 @@ from tfservingcache_tpu.cache.providers.base import (
     ModelNotFoundError,
     ModelProvider,
     ProviderError,
+    atomic_dest,
 )
 from tfservingcache_tpu.types import Model, ModelId
 
@@ -44,10 +45,8 @@ class DiskModelProvider(ModelProvider):
 
     def load_model(self, name: str, version: int, dest_dir: str) -> Model:
         src = self._find_src_path(name, version)
-        if os.path.exists(dest_dir):
-            shutil.rmtree(dest_dir)
-        os.makedirs(os.path.dirname(dest_dir), exist_ok=True)
-        shutil.copytree(src, dest_dir)
+        with atomic_dest(dest_dir) as tmp:
+            shutil.copytree(src, tmp)
         return Model(
             identifier=ModelId(name, version),
             path=dest_dir,

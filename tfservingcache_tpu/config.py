@@ -149,12 +149,25 @@ def _coerce(value: str, target: Any) -> Any:
 
 
 def _apply_mapping(cfg: Any, data: dict[str, Any], path: str = "") -> None:
+    known = {f.name for f in dataclasses.fields(cfg)}
+    unknown = set(data) - known
+    if unknown:
+        # loud but permissive: a typo'd or reference-style camelCase key must
+        # not silently degrade to defaults
+        import logging
+
+        logging.getLogger("tpusc.config").warning(
+            "ignoring unknown config key(s) %s under %r (known: %s)",
+            sorted(unknown), path or ".", sorted(known),
+        )
     for f in dataclasses.fields(cfg):
         if f.name not in data:
             continue
         val = data[f.name]
         cur = getattr(cfg, f.name)
         if dataclasses.is_dataclass(cur):
+            if val is None:
+                continue  # empty YAML section ("discovery:" with children commented out)
             if not isinstance(val, dict):
                 raise ValueError(
                     f"config section {path}{f.name!s} must be a mapping, got {type(val).__name__}"

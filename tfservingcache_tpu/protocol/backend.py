@@ -1,0 +1,80 @@
+"""The seam between protocol servers and whatever fulfils requests.
+
+Reference equivalent: the pluggable "director"/clientProvider closures that
+let the same L0 proxy code serve two roles (SURVEY.md §1 "key structural
+fact"): the task handler's director targets a *remote peer*
+(pkg/taskhandler/taskhandler.go:95-147) while the cache manager's director
+ensures the model is loaded *locally* (pkg/cachemanager/cachemanager.go:268-292).
+Here the seam is an abstract async backend; protocol servers (REST + gRPC)
+are instantiated twice with different backends:
+
+  - ``LocalServingBackend`` (cache manager + in-process JAX runtime);
+  - ``RoutingBackend`` (consistent-hash peer forwarding).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+
+
+@dataclass
+class RestResponse:
+    status: int
+    body: bytes
+    content_type: str = "application/json"
+    headers: dict[str, str] = field(default_factory=dict)
+
+
+class BackendError(Exception):
+    """Carries a gRPC status code + message; REST maps it onto HTTP."""
+
+    def __init__(self, message: str, grpc_code=None, http_status: int = 500) -> None:
+        super().__init__(message)
+        self.grpc_code = grpc_code
+        self.http_status = http_status
+
+
+class ServingBackend(abc.ABC):
+    """All tensorflow.serving RPCs + the raw REST path."""
+
+    # gRPC-shaped entry points (decoded messages in/out)
+    @abc.abstractmethod
+    async def predict(self, request: sv.PredictRequest) -> sv.PredictResponse: ...
+
+    @abc.abstractmethod
+    async def classify(self, request: sv.ClassificationRequest) -> sv.ClassificationResponse: ...
+
+    @abc.abstractmethod
+    async def regress(self, request: sv.RegressionRequest) -> sv.RegressionResponse: ...
+
+    @abc.abstractmethod
+    async def get_model_metadata(
+        self, request: sv.GetModelMetadataRequest
+    ) -> sv.GetModelMetadataResponse: ...
+
+    @abc.abstractmethod
+    async def session_run(self, request: sv.SessionRunRequest) -> sv.SessionRunResponse: ...
+
+    @abc.abstractmethod
+    async def get_model_status(
+        self, request: sv.GetModelStatusRequest
+    ) -> sv.GetModelStatusResponse: ...
+
+    @abc.abstractmethod
+    async def reload_config(self, request: sv.ReloadConfigRequest) -> sv.ReloadConfigResponse: ...
+
+    # REST-shaped entry point: the server has validated/parsed the URL; the
+    # backend decides whether to decode the body (local) or forward it
+    # opaquely (router), mirroring the reference's transparent REST proxying.
+    @abc.abstractmethod
+    async def handle_rest(
+        self,
+        method: str,
+        model_name: str,
+        version: int | None,
+        verb: str | None,
+        body: bytes,
+    ) -> RestResponse: ...

@@ -1,0 +1,253 @@
+"""Tensor wire codecs.
+
+Two encodings, both defined by the TF Serving protocol the reference proxies
+opaquely (it never touches tensors — SURVEY.md §5 "long-context" note; we
+must actually decode them because inference is in-process now):
+
+  - TensorProto <-> numpy (gRPC path), incl. bfloat16/half via ml_dtypes;
+  - the REST ``:predict`` JSON body (row "instances" / columnar "inputs"
+    formats, base64 ``{"b64": ...}`` byte strings).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Mapping
+
+import ml_dtypes
+import numpy as np
+
+from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
+
+# DataType <-> numpy dtype
+_DT_TO_NP = {
+    core.DT_FLOAT: np.dtype(np.float32),
+    core.DT_DOUBLE: np.dtype(np.float64),
+    core.DT_INT32: np.dtype(np.int32),
+    core.DT_UINT8: np.dtype(np.uint8),
+    core.DT_INT16: np.dtype(np.int16),
+    core.DT_INT8: np.dtype(np.int8),
+    core.DT_INT64: np.dtype(np.int64),
+    core.DT_BOOL: np.dtype(np.bool_),
+    core.DT_UINT16: np.dtype(np.uint16),
+    core.DT_UINT32: np.dtype(np.uint32),
+    core.DT_UINT64: np.dtype(np.uint64),
+    core.DT_HALF: np.dtype(np.float16),
+    core.DT_BFLOAT16: np.dtype(ml_dtypes.bfloat16),
+    core.DT_COMPLEX64: np.dtype(np.complex64),
+    core.DT_COMPLEX128: np.dtype(np.complex128),
+}
+_NP_TO_DT = {v: k for k, v in _DT_TO_NP.items()}
+
+# the repeated *_val field per dtype (TensorProto wire format)
+_VAL_FIELD = {
+    core.DT_FLOAT: "float_val",
+    core.DT_DOUBLE: "double_val",
+    core.DT_INT32: "int_val",
+    core.DT_UINT8: "int_val",
+    core.DT_INT16: "int_val",
+    core.DT_INT8: "int_val",
+    core.DT_INT64: "int64_val",
+    core.DT_BOOL: "bool_val",
+    core.DT_UINT16: "int_val",
+    core.DT_UINT32: "uint32_val",
+    core.DT_UINT64: "uint64_val",
+    core.DT_HALF: "half_val",
+    core.DT_BFLOAT16: "half_val",
+}
+
+
+class CodecError(ValueError):
+    pass
+
+
+def numpy_to_tensorproto(arr: np.ndarray) -> core.TensorProto:
+    """Dense encode via ``tensor_content`` (the compact form TF clients send
+    for large tensors); DT_STRING uses ``string_val``."""
+    arr = np.asarray(arr)
+    tp = core.TensorProto()
+    for d in arr.shape:
+        tp.tensor_shape.dim.add(size=int(d))
+    if arr.dtype.kind in ("U", "S", "O"):
+        tp.dtype = core.DT_STRING
+        for item in arr.reshape(-1):
+            tp.string_val.append(item.encode() if isinstance(item, str) else bytes(item))
+        return tp
+    dt = _NP_TO_DT.get(arr.dtype)
+    if dt is None:
+        raise CodecError(f"unsupported numpy dtype {arr.dtype}")
+    tp.dtype = dt
+    tp.tensor_content = np.ascontiguousarray(arr).tobytes()
+    return tp
+
+
+def tensorproto_to_numpy(tp: core.TensorProto) -> np.ndarray:
+    if tp.tensor_shape.unknown_rank:
+        raise CodecError("unknown-rank tensors are not supported")
+    shape = tuple(d.size for d in tp.tensor_shape.dim)
+    n = int(np.prod(shape)) if shape else 1
+
+    if tp.dtype == core.DT_STRING:
+        vals = [bytes(v) for v in tp.string_val]
+        if len(vals) == 1 and n > 1:
+            vals = vals * n
+        arr = np.array(vals, dtype=object)
+        return arr.reshape(shape)
+
+    np_dtype = _DT_TO_NP.get(tp.dtype)
+    if np_dtype is None:
+        raise CodecError(f"unsupported TensorProto dtype {tp.dtype}")
+
+    if tp.tensor_content:
+        arr = np.frombuffer(tp.tensor_content, dtype=np_dtype)
+        if arr.size != n:
+            raise CodecError(f"tensor_content holds {arr.size} elements, shape needs {n}")
+        return arr.reshape(shape).copy()
+
+    field = _VAL_FIELD.get(tp.dtype)
+    if field is None:
+        raise CodecError(f"no value field for dtype {tp.dtype}")
+    raw = list(getattr(tp, field))
+    if tp.dtype in (core.DT_HALF, core.DT_BFLOAT16):
+        # half/bfloat16 values travel as the low 16 bits of int32s
+        raw16 = np.array(raw, dtype=np.uint16)
+        arr = raw16.view(np_dtype)
+    else:
+        arr = np.array(raw, dtype=np_dtype)
+    if arr.size == 1 and n > 1:
+        # single-value fill semantics (TF MakeNdarray broadcast)
+        arr = np.full(n, arr[0], dtype=np_dtype)
+    if arr.size != n:
+        raise CodecError(f"{field} holds {arr.size} elements, shape needs {n}")
+    return arr.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# REST JSON (TF Serving REST API)
+# ---------------------------------------------------------------------------
+
+def _json_to_value(obj: Any) -> Any:
+    """Recursively turn ``{"b64": ...}`` leaves into bytes."""
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"b64"}:
+            return base64.b64decode(obj["b64"])
+        return {k: _json_to_value(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_json_to_value(v) for v in obj]
+    return obj
+
+
+def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
+    value = _json_to_value(value)
+
+    def has_bytes(v: Any) -> bool:
+        if isinstance(v, (bytes, str)):
+            return True
+        if isinstance(v, list) and v:
+            return has_bytes(v[0])
+        return False
+
+    if has_bytes(value):
+        return np.array(value, dtype=object)
+    arr = np.asarray(value)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)  # JSON numbers default to f32 for the MXU
+    return arr
+
+
+def decode_predict_json(
+    body: Mapping[str, Any],
+    input_dtypes: Mapping[str, np.dtype] | None = None,
+    default_input: str = "inputs",
+) -> tuple[dict[str, np.ndarray], str]:
+    """Decode a ``:predict`` JSON body -> (named input arrays, signature_name).
+
+    Row format: ``{"instances": [row, ...]}`` — each row is a value (single
+    input) or ``{name: value}`` (multi input); rows are stacked on axis 0.
+    Columnar: ``{"inputs": value-or-{name: value}}``.
+    """
+    input_dtypes = dict(input_dtypes or {})
+    signature = body.get("signature_name", "serving_default")
+    if ("instances" in body) == ("inputs" in body):
+        raise CodecError('exactly one of "instances" or "inputs" must be provided')
+
+    def dtype_for(name: str) -> np.dtype | None:
+        return input_dtypes.get(name)
+
+    if "instances" in body:
+        instances = body["instances"]
+        if not isinstance(instances, list) or not instances:
+            raise CodecError('"instances" must be a non-empty list')
+        if isinstance(instances[0], dict) and "b64" not in instances[0]:
+            names = list(instances[0].keys())
+            cols: dict[str, list[Any]] = {n: [] for n in names}
+            for row in instances:
+                if not isinstance(row, dict) or set(row.keys()) != set(names):
+                    raise CodecError("all instances must name the same inputs")
+                for n in names:
+                    cols[n].append(row[n])
+            return (
+                {n: _value_to_array(v, dtype_for(n)) for n, v in cols.items()},
+                signature,
+            )
+        if len(input_dtypes) == 1:
+            (only_name,) = input_dtypes.keys()
+        else:
+            only_name = default_input
+        return {only_name: _value_to_array(instances, dtype_for(only_name))}, signature
+
+    inputs = body["inputs"]
+    if isinstance(inputs, dict) and "b64" not in inputs:
+        return (
+            {n: _value_to_array(v, dtype_for(n)) for n, v in inputs.items()},
+            signature,
+        )
+    if len(input_dtypes) == 1:
+        (only_name,) = input_dtypes.keys()
+    else:
+        only_name = default_input
+    return {only_name: _value_to_array(inputs, dtype_for(only_name))}, signature
+
+
+def _array_to_json(arr: np.ndarray) -> Any:
+    if arr.dtype == object or arr.dtype.kind in ("S", "U"):
+        def enc(v: Any) -> Any:
+            if isinstance(v, list):
+                return [enc(x) for x in v]
+            if isinstance(v, bytes):
+                return {"b64": base64.b64encode(v).decode()}
+            return str(v)
+
+        return enc(arr.tolist())
+    if arr.dtype in (np.dtype(np.float16), np.dtype(ml_dtypes.bfloat16)):
+        arr = arr.astype(np.float32)
+    return arr.tolist()
+
+
+def encode_predict_json(outputs: Mapping[str, np.ndarray], row_format: bool) -> dict[str, Any]:
+    """Encode named output arrays as the ``:predict`` response body.
+
+    Row: ``{"predictions": [...]}`` — single output unwrapped, multi-output as
+    per-row dicts. Columnar: ``{"outputs": ...}``.
+    """
+    outputs = dict(outputs)
+    if row_format:
+        if len(outputs) == 1:
+            (arr,) = outputs.values()
+            return {"predictions": _array_to_json(np.asarray(arr))}
+        names = list(outputs.keys())
+        arrays = {n: np.asarray(a) for n, a in outputs.items()}
+        batch_sizes = {arrays[n].shape[0] if arrays[n].ndim else 1 for n in names}
+        if len(batch_sizes) != 1:
+            raise CodecError(f"output batch dims disagree: {batch_sizes}")
+        (batch,) = batch_sizes
+        rows = []
+        for i in range(batch):
+            rows.append({n: _array_to_json(arrays[n][i]) for n in names})
+        return {"predictions": rows}
+    if len(outputs) == 1:
+        (arr,) = outputs.values()
+        return {"outputs": _array_to_json(np.asarray(arr))}
+    return {"outputs": {n: _array_to_json(np.asarray(a)) for n, a in outputs.items()}}

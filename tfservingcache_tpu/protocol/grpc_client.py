@@ -1,0 +1,45 @@
+"""gRPC client for peer cache nodes (and the test client).
+
+Reference equivalent: the per-peer cached channels in
+pkg/taskhandler/taskhandler.go:117-147 use generated stubs; here callables
+are built from the shared METHOD_TABLE so client and server can't drift.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tfservingcache_tpu.protocol.grpc_server import METHOD_TABLE
+
+
+class ServingStub:
+    """All tensorflow.serving methods on one channel, lazily materialized."""
+
+    def __init__(self, channel: grpc.aio.Channel) -> None:
+        self.channel = channel
+        self._callables: dict[tuple[str, str], grpc.aio.UnaryUnaryMultiCallable] = {}
+
+    def method(self, service: str, method: str) -> grpc.aio.UnaryUnaryMultiCallable:
+        key = (service, method)
+        if key not in self._callables:
+            req_cls, resp_cls = METHOD_TABLE[key]
+            self._callables[key] = self.channel.unary_unary(
+                f"/{service}/{method}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+        return self._callables[key]
+
+
+def make_channel(target: str, max_message_bytes: int = 16 << 20) -> grpc.aio.Channel:
+    """Insecure channel with the reference's message cap and dial backoff
+    characteristics (taskhandler.go:136-141)."""
+    return grpc.aio.insecure_channel(
+        target,
+        options=[
+            ("grpc.max_receive_message_length", max_message_bytes),
+            ("grpc.max_send_message_length", max_message_bytes),
+            ("grpc.initial_reconnect_backoff_ms", 100),
+            ("grpc.max_reconnect_backoff_ms", 5000),
+        ],
+    )

@@ -1,0 +1,185 @@
+"""gRPC servers for the tensorflow.serving protocol, built on generic method
+handlers (no grpc_tools codegen in this image — service registration is done
+with explicit method tables; the wire is identical to stub-generated code).
+
+Reference equivalent: pkg/tfservingproxy/tfservingproxy.go:76-250
+(PredictionService + SessionService + grpc-health on one server). This build
+also registers ModelService (GetModelStatus/HandleReloadConfigRequest) on
+the cache node — the reference delegates those to the external TF Serving
+process, which no longer exists.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+
+from tfservingcache_tpu.protocol.backend import BackendError, ServingBackend
+from tfservingcache_tpu.protocol.protos import grpc_health_pb2 as health_pb
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.metrics import Metrics
+
+log = get_logger("grpc")
+
+PREDICTION_SERVICE = "tensorflow.serving.PredictionService"
+MODEL_SERVICE = "tensorflow.serving.ModelService"
+SESSION_SERVICE = "tensorflow.serving.SessionService"
+HEALTH_SERVICE = "grpc.health.v1.Health"
+
+# (service, method) -> (request class, response class); shared by server and
+# client so the two sides can't drift.
+METHOD_TABLE: dict[tuple[str, str], tuple[type, type]] = {
+    (PREDICTION_SERVICE, "Predict"): (sv.PredictRequest, sv.PredictResponse),
+    (PREDICTION_SERVICE, "Classify"): (sv.ClassificationRequest, sv.ClassificationResponse),
+    (PREDICTION_SERVICE, "Regress"): (sv.RegressionRequest, sv.RegressionResponse),
+    (PREDICTION_SERVICE, "MultiInference"): (sv.MultiInferenceRequest, sv.MultiInferenceResponse),
+    (PREDICTION_SERVICE, "GetModelMetadata"): (
+        sv.GetModelMetadataRequest,
+        sv.GetModelMetadataResponse,
+    ),
+    (MODEL_SERVICE, "GetModelStatus"): (sv.GetModelStatusRequest, sv.GetModelStatusResponse),
+    (MODEL_SERVICE, "HandleReloadConfigRequest"): (sv.ReloadConfigRequest, sv.ReloadConfigResponse),
+    (SESSION_SERVICE, "SessionRun"): (sv.SessionRunRequest, sv.SessionRunResponse),
+}
+
+
+class HealthState:
+    """In-process grpc.health.v1 implementation (grpcio-health-checking is not
+    in the image). SetHealth semantics follow the reference
+    (tfservingproxy.go:151-157): one overall status on the empty service name."""
+
+    def __init__(self) -> None:
+        self._status = health_pb.HealthCheckResponse.NOT_SERVING
+        self._event = asyncio.Event()
+
+    def set_health(self, healthy: bool) -> None:
+        self._status = (
+            health_pb.HealthCheckResponse.SERVING
+            if healthy
+            else health_pb.HealthCheckResponse.NOT_SERVING
+        )
+        self._event.set()
+        self._event = asyncio.Event()
+
+    @property
+    def status(self) -> int:
+        return self._status
+
+    async def wait_change(self) -> None:
+        await self._event.wait()
+
+
+class GrpcServingServer:
+    def __init__(
+        self,
+        backend: ServingBackend,
+        metrics: Metrics | None = None,
+        max_message_bytes: int = 16 << 20,   # reference default (cachemanager.go:230-233)
+    ) -> None:
+        self.backend = backend
+        self.metrics = metrics
+        self.health = HealthState()
+        self._max_message_bytes = max_message_bytes
+        self.server: grpc.aio.Server | None = None
+        self.port: int | None = None
+
+    # -- handler plumbing ---------------------------------------------------
+    def _unary(self, fn, req_cls, resp_cls):
+        async def handler(request, context: grpc.aio.ServicerContext):
+            if self.metrics is not None:
+                self.metrics.request_count.labels("grpc").inc()
+            try:
+                return await fn(request)
+            except BackendError as e:
+                if self.metrics is not None:
+                    self.metrics.request_failures.labels("grpc").inc()
+                await context.abort(e.grpc_code or grpc.StatusCode.INTERNAL, str(e))
+            except grpc.aio.AioRpcError as e:
+                # peer-forwarding failure: surface the upstream code verbatim
+                if self.metrics is not None:
+                    self.metrics.request_failures.labels("grpc").inc()
+                await context.abort(e.code(), e.details() or "upstream error")
+            except Exception as e:  # noqa: BLE001
+                if self.metrics is not None:
+                    self.metrics.request_failures.labels("grpc").inc()
+                log.exception("unhandled error in %s", fn.__name__)
+                await context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+
+    async def _multi_inference(self, request):
+        # Parity with the reference: MultiInference is rejected
+        # (tfservingproxy.go:215-217).
+        raise BackendError("MultiInference not supported", grpc.StatusCode.UNIMPLEMENTED, 501)
+
+    def _handlers(self) -> list[grpc.GenericRpcHandler]:
+        b = self.backend
+        impl = {
+            (PREDICTION_SERVICE, "Predict"): b.predict,
+            (PREDICTION_SERVICE, "Classify"): b.classify,
+            (PREDICTION_SERVICE, "Regress"): b.regress,
+            (PREDICTION_SERVICE, "MultiInference"): self._multi_inference,
+            (PREDICTION_SERVICE, "GetModelMetadata"): b.get_model_metadata,
+            (MODEL_SERVICE, "GetModelStatus"): b.get_model_status,
+            (MODEL_SERVICE, "HandleReloadConfigRequest"): b.reload_config,
+            (SESSION_SERVICE, "SessionRun"): b.session_run,
+        }
+        per_service: dict[str, dict[str, grpc.RpcMethodHandler]] = {}
+        for (service, method), fn in impl.items():
+            req_cls, resp_cls = METHOD_TABLE[(service, method)]
+            per_service.setdefault(service, {})[method] = self._unary(fn, req_cls, resp_cls)
+
+        # grpc.health.v1
+        async def check(request, context):
+            return health_pb.HealthCheckResponse(status=self.health.status)
+
+        async def watch(request, context):
+            while True:
+                yield health_pb.HealthCheckResponse(status=self.health.status)
+                await self.health.wait_change()
+
+        per_service[HEALTH_SERVICE] = {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                check,
+                request_deserializer=health_pb.HealthCheckRequest.FromString,
+                response_serializer=health_pb.HealthCheckResponse.SerializeToString,
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                watch,
+                request_deserializer=health_pb.HealthCheckRequest.FromString,
+                response_serializer=health_pb.HealthCheckResponse.SerializeToString,
+            ),
+        }
+        return [
+            grpc.method_handlers_generic_handler(service, methods)
+            for service, methods in per_service.items()
+        ]
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self, port: int, host: str = "0.0.0.0") -> int:
+        self.server = grpc.aio.server(
+            options=[
+                ("grpc.max_receive_message_length", self._max_message_bytes),
+                ("grpc.max_send_message_length", self._max_message_bytes),
+            ]
+        )
+        for h in self._handlers():
+            self.server.add_generic_rpc_handlers((h,))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        await self.server.start()
+        log.info("gRPC server listening on %s:%d", host, self.port)
+        return self.port
+
+    def set_health(self, healthy: bool) -> None:
+        self.health.set_health(healthy)
+
+    async def close(self, grace: float = 2.0) -> None:
+        if self.server is not None:
+            await self.server.stop(grace)
+            self.server = None

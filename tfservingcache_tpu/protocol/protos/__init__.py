@@ -1,0 +1,4 @@
+"""Generated protobuf modules (see generate.sh). Import via:
+
+    from tfservingcache_tpu.protocol.protos import tf_core_pb2, tf_serving_pb2
+"""

@@ -1,0 +1,14 @@
+#!/bin/sh
+# Regenerate _pb2.py from the hand-written protos. Run from the repo root:
+#   sh tfservingcache_tpu/protocol/protos/generate.sh
+# Plain protoc is enough: gRPC services are implemented with generic method
+# handlers (grpc_tools/protoc-gen-grpc_python is not in this image).
+set -e
+cd "$(dirname "$0")/../../.."
+protoc -I. \
+  tfservingcache_tpu/protocol/protos/tf_core.proto \
+  tfservingcache_tpu/protocol/protos/tf_serving.proto \
+  tfservingcache_tpu/protocol/protos/grpc_health.proto \
+  --python_out=.
+echo "generated:"
+ls tfservingcache_tpu/protocol/protos/*_pb2.py

@@ -1,0 +1,151 @@
+"""REST server for the TF Serving HTTP API.
+
+Reference equivalent: pkg/tfservingproxy/tfservingproxy.go:36-129 — the same
+URL contract, kept bug-for-bug compatible on the *success-path* semantics
+only (the reference's failure counter increments on every request,
+tfservingproxy.go:62-66 — fixed here, SURVEY.md §7):
+
+  - case-insensitive match of ``/v1/models/<name>[/versions/<version>]``
+    (tfservingproxy.go:24);
+  - no match       -> 404 ``{"Status": "Error", "Message": "Not found"}``;
+  - missing version-> 400 ``{"Status": "Error", "Message": "Model version must be provided"}``
+    (tfservingproxy.go:99-124).
+
+Verb suffixes (``:predict`` etc.), GET status, and GET metadata are parsed
+here and handed to the backend; the reference forwarded them opaquely to
+TF Serving, which no longer exists.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from aiohttp import web
+
+from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.metrics import Metrics
+
+log = get_logger("rest")
+
+# reference regex, tfservingproxy.go:24
+URL_RE = re.compile(r"^/v1/models/(?P<name>[^/]+?)(/versions/(?P<version>[0-9]+))?$", re.I)
+
+VERBS = ("predict", "classify", "regress")
+
+
+def _error_body(message: str) -> bytes:
+    # exact reference shape (tfservingproxy.go:102-108)
+    return json.dumps({"Status": "Error", "Message": message}).encode()
+
+
+def parse_model_url(path: str) -> tuple[str, int | None, str | None] | None:
+    """-> (model_name, version|None, verb|None), or None when unroutable.
+
+    ``verb`` is ``predict``/``classify``/``regress``/``metadata`` or None
+    (bare GET = status probe).
+    """
+    verb: str | None = None
+    if ":" in path:
+        path, _, v = path.rpartition(":")
+        if v.lower() not in VERBS:
+            return None
+        verb = v.lower()
+    elif path.lower().endswith("/metadata"):
+        path = path[: -len("/metadata")]
+        verb = "metadata"
+    m = URL_RE.match(path)
+    if not m:
+        return None
+    version = m.group("version")
+    return m.group("name"), (int(version) if version is not None else None), verb
+
+
+class RestServingServer:
+    def __init__(
+        self,
+        backend: ServingBackend,
+        metrics: Metrics | None = None,
+        require_version: bool = True,
+        metrics_path: str | None = None,
+        max_body_bytes: int = 256 << 20,
+    ) -> None:
+        self.backend = backend
+        self.metrics = metrics
+        # The reference 400s when the URL has no version (tfservingproxy.go:112);
+        # on the cache node the router always sends versioned URLs.
+        self.require_version = require_version
+        self.metrics_path = metrics_path
+        self.app = web.Application(client_max_size=max_body_bytes)
+        self.app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        path = request.path
+        if self.metrics_path and path == self.metrics_path and self.metrics is not None:
+            return web.Response(body=self.metrics.render(), content_type="text/plain")
+        if path == "/healthz":
+            return web.json_response({"status": "ok"})
+
+        if self.metrics is not None:
+            self.metrics.request_count.labels("rest").inc()
+
+        parsed = parse_model_url(path)
+        if parsed is None:
+            return self._fail(web.Response(
+                status=404, body=_error_body("Not found"), content_type="application/json"
+            ))
+        name, version, verb = parsed
+        if version is None and self.require_version:
+            return self._fail(web.Response(
+                status=400,
+                body=_error_body("Model version must be provided"),
+                content_type="application/json",
+            ))
+        body = await request.read()
+        try:
+            resp: RestResponse = await self.backend.handle_rest(
+                request.method, name, version, verb, body
+            )
+        except BackendError as e:
+            return self._fail(web.Response(
+                status=e.http_status,
+                body=json.dumps({"error": str(e)}).encode(),
+                content_type="application/json",
+            ))
+        except Exception as e:  # noqa: BLE001
+            log.exception("unhandled REST error for %s", path)
+            return self._fail(web.Response(
+                status=500,
+                body=json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
+                content_type="application/json",
+            ))
+        if resp.status >= 400 and self.metrics is not None:
+            self.metrics.request_failures.labels("rest").inc()
+        return web.Response(
+            status=resp.status,
+            body=resp.body,
+            content_type=resp.content_type,
+            headers=resp.headers,
+        )
+
+    def _fail(self, response: web.Response) -> web.Response:
+        if self.metrics is not None:
+            self.metrics.request_failures.labels("rest").inc()
+        return response
+
+    async def start(self, port: int, host: str = "0.0.0.0") -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # resolves port 0
+        log.info("REST server listening on %s:%d", host, self.port)
+        return self.port
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -28,23 +28,27 @@ class Metrics:
         self.registry = CollectorRegistry()
         self.model_labels = model_labels
         r = self.registry
-        # L0 proxy counters (reference tfservingproxy.go:25-32) — and unlike the
-        # reference, the failure counter only counts failures (SURVEY.md §2 C3 bug).
+        # Exposed names match the reference exactly (prometheus_client appends
+        # "_total" to counters, so the constructor names omit it):
+        #   tfservingcache_proxy_requests_total / _proxy_failures_total
+        #     (reference tfservingproxy.go:25-32) — and unlike the reference,
+        #     the failure counter only counts failures (SURVEY.md §2 C3 bug);
+        #   tfservingcache_cache_total / _cache_hits_total / _cache_misses_total
+        #     (reference cachemanager.go:24-35).
         self.request_count = Counter(
-            "tfservingcache_request_count", "Number of requests", ["protocol"], registry=r
+            "tfservingcache_proxy_requests", "The total number of requests", ["protocol"], registry=r
         )
         self.request_failures = Counter(
-            "tfservingcache_request_fail_count", "Number of failed requests", ["protocol"], registry=r
+            "tfservingcache_proxy_failures", "The total number of failed requests", ["protocol"], registry=r
         )
-        # L3 cache counters/histograms (reference cachemanager.go:24-43)
         self.cache_total = Counter(
-            "tfservingcache_cache_total_count", "Cache lookups", ["model"], registry=r
+            "tfservingcache_cache", "Cache lookups", ["model"], registry=r
         )
         self.cache_hits = Counter(
-            "tfservingcache_cache_hit_count", "Cache hits", ["model"], registry=r
+            "tfservingcache_cache_hits", "Cache hits", ["model"], registry=r
         )
         self.cache_misses = Counter(
-            "tfservingcache_cache_miss_count", "Cache misses", ["model"], registry=r
+            "tfservingcache_cache_misses", "Cache misses", ["model"], registry=r
         )
         self.cache_duration = Histogram(
             "tfservingcache_cache_duration_seconds",
