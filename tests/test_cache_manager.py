@@ -1,0 +1,136 @@
+"""CacheManager state-machine tests against FakeRuntime — the coverage the
+reference never had (SURVEY.md §4: fetchModel orchestration untested there
+because the backend lived in another process)."""
+
+import threading
+
+import pytest
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.runtime.fake import FakeRuntime
+from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.metrics import Metrics
+
+
+def make_store(root, models):
+    for name, version, nbytes in models:
+        d = root / name / str(version)
+        d.mkdir(parents=True)
+        (d / "params.bin").write_bytes(b"p" * nbytes)
+    return DiskModelProvider(str(root))
+
+
+@pytest.fixture()
+def setup(tmp_path):
+    provider = make_store(
+        tmp_path / "store",
+        [("a", 1, 100), ("a", 2, 100), ("b", 1, 100), ("c", 1, 100)],
+    )
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=250)
+    runtime = FakeRuntime()
+    manager = CacheManager(provider, cache, runtime, Metrics())
+    return manager, runtime, cache
+
+
+def counter_value(metrics, counter, label):
+    return counter.labels(label)._value.get()
+
+
+def test_miss_then_hit_then_stale(setup):
+    manager, runtime, cache = setup
+    mid = ModelId("a", 1)
+    manager.ensure_servable(mid)          # MISS: fetch + load
+    assert runtime.loads == [mid]
+    manager.ensure_servable(mid)          # HIT: nothing new
+    assert runtime.loads == [mid]
+    runtime.unload(mid)                   # simulate HBM eviction
+    manager.ensure_servable(mid)          # STALE: reload without re-fetch
+    assert runtime.loads == [mid, mid]
+    m = manager.metrics
+    assert counter_value(m, m.cache_misses, "all_models") == 1
+    assert counter_value(m, m.cache_hits, "all_models") == 2
+    assert counter_value(m, m.cache_total, "all_models") == 3
+
+
+def test_disk_eviction_unloads_runtime(setup):
+    manager, runtime, cache = setup
+    a, b, c = ModelId("a", 1), ModelId("b", 1), ModelId("c", 1)
+    manager.ensure_servable(a)
+    manager.ensure_servable(b)
+    manager.ensure_servable(c)            # cache holds 2x100+requires eviction of a
+    cache.drain_evictions()
+    assert a not in cache.lru
+    assert not runtime.is_loaded(a)       # disk eviction must drop the executable too
+    assert runtime.is_loaded(b) and runtime.is_loaded(c)
+
+
+def test_singleflight_coalesces_same_model(tmp_path):
+    provider = make_store(tmp_path / "store", [("m", 1, 50)])
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime(load_delay_s=0.05)
+    manager = CacheManager(provider, cache, runtime)
+    mid = ModelId("m", 1)
+    threads = [threading.Thread(target=manager.ensure_servable, args=(mid,)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert runtime.loads == [mid]         # exactly one load despite 8 racers
+
+
+def test_concurrent_misses_on_different_models_parallel(tmp_path):
+    # the reference's global mutex would serialize these (README.md:75 todo)
+    provider = make_store(tmp_path / "store", [(f"m{i}", 1, 10) for i in range(4)])
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime(load_delay_s=0.1)
+    manager = CacheManager(provider, cache, runtime)
+    threads = [
+        threading.Thread(target=manager.ensure_servable, args=(ModelId(f"m{i}", 1),))
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(runtime.loads) == 4
+    assert runtime.max_concurrent_loads >= 2   # actually overlapped
+
+
+def test_load_failure_propagates_and_leaves_cache_consistent(tmp_path):
+    provider = make_store(tmp_path / "store", [("m", 1, 50)])
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime(fail_loads={ModelId("m", 1)})
+    manager = CacheManager(provider, cache, runtime)
+    with pytest.raises(Exception, match="fake load failure"):
+        manager.ensure_servable(ModelId("m", 1))
+    # artifact stays cached (fetch succeeded); next attempt is STALE not MISS
+    runtime.fail_loads.clear()
+    manager.ensure_servable(ModelId("m", 1))
+    assert runtime.is_loaded(ModelId("m", 1))
+
+
+def test_unknown_model_raises(setup):
+    manager, _, _ = setup
+    from tfservingcache_tpu.cache.providers.base import ModelNotFoundError
+
+    with pytest.raises(ModelNotFoundError):
+        manager.ensure_servable(ModelId("ghost", 1))
+
+
+def test_resolve_version(setup):
+    manager, runtime, _ = setup
+    assert manager.resolve_version("a", 2) == 2          # explicit wins
+    assert manager.resolve_version("a", None) == 2       # provider latest
+    manager.ensure_servable(ModelId("a", 1))
+    assert manager.resolve_version("a", None) == 1       # loaded version preferred
+    with pytest.raises(Exception):
+        manager.resolve_version("ghost", None)
+
+
+def test_health(setup, tmp_path):
+    manager, _, _ = setup
+    assert manager.is_healthy()
+    manager.provider = DiskModelProvider(str(tmp_path / "missing"))
+    assert not manager.is_healthy()

@@ -19,6 +19,7 @@ def test_eviction_deletes_tree(tmp_path):
         cache.put(write_artifact(cache, mid, 100))
     pa = cache.model_path(a)
     cache.put(write_artifact(cache, c, 100))  # evicts a
+    cache.drain_evictions()
     assert not os.path.exists(pa)
     assert cache.get(a) is None
     assert cache.get(b) is not None and cache.get(c) is not None

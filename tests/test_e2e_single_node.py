@@ -1,0 +1,181 @@
+"""Minimum end-to-end slice (SURVEY.md §7 steps 1-4): half_plus_two via the
+disk provider, served cold and warm through real REST + gRPC servers backed
+by the real JAX runtime — single node, no cluster."""
+
+import json
+from contextlib import asynccontextmanager
+
+import aiohttp
+import numpy as np
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
+from tfservingcache_tpu.protocol.grpc_server import (
+    MODEL_SERVICE,
+    PREDICTION_SERVICE,
+    SESSION_SERVICE,
+    GrpcServingServer,
+)
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.utils.metrics import Metrics
+
+
+@asynccontextmanager
+async def single_node(tmp_path, families=(("half_plus_two", "hpt", 1),)):
+    store = tmp_path / "store"
+    for family, name, version in families:
+        export_artifact(family, str(store), name=name, version=version)
+    provider = DiskModelProvider(str(store))
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30)
+    metrics = Metrics()
+    runtime = TPUModelRuntime(ServingConfig(), metrics)
+    manager = CacheManager(provider, cache, runtime, metrics)
+    backend = LocalServingBackend(manager)
+    rest = RestServingServer(backend, metrics, require_version=False)
+    grpc_srv = GrpcServingServer(backend, metrics)
+    rport = await rest.start(0, host="127.0.0.1")
+    gport = await grpc_srv.start(0, host="127.0.0.1")
+    try:
+        yield rport, gport, manager, metrics
+    finally:
+        backend.close()
+        await rest.close()
+        await grpc_srv.close()
+        manager.close()
+
+
+async def test_rest_cold_then_warm(tmp_path):
+    async with single_node(tmp_path) as (rport, _, manager, metrics):
+        base = f"http://127.0.0.1:{rport}"
+        async with aiohttp.ClientSession() as s:
+            # cold: fetch + compile + predict
+            async with s.post(
+                f"{base}/v1/models/hpt/versions/1:predict",
+                json={"instances": [1.0, 2.0, 3.0]},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+                data = await resp.json()
+            assert data == {"predictions": [2.5, 3.0, 3.5]}
+            # warm hit
+            async with s.post(
+                f"{base}/v1/models/hpt/versions/1:predict",
+                json={"inputs": {"x": [10.0]}},
+            ) as resp:
+                data = await resp.json()
+            assert data == {"outputs": [7.0]}
+            # no version in URL -> resolves to latest
+            async with s.post(
+                f"{base}/v1/models/hpt:predict", json={"instances": [0.0]}
+            ) as resp:
+                assert (await resp.json()) == {"predictions": [2.0]}
+            # status + metadata
+            async with s.get(f"{base}/v1/models/hpt/versions/1") as resp:
+                st = await resp.json()
+            assert st["model_version_status"][0]["state"] == "AVAILABLE"
+            async with s.get(f"{base}/v1/models/hpt/versions/1/metadata") as resp:
+                meta = await resp.json()
+            assert meta["metadata"]["signature_def"]["signature_def"]["serving_default"][
+                "inputs"
+            ]["x"]["dtype"] == "float32"
+            # unknown model -> 404
+            async with s.post(
+                f"{base}/v1/models/ghost/versions/1:predict", json={"instances": [1]}
+            ) as resp:
+                assert resp.status == 404
+
+
+async def test_grpc_full_surface(tmp_path):
+    async with single_node(tmp_path) as (_, gport, manager, _):
+        channel = make_channel(f"127.0.0.1:{gport}")
+        stub = ServingStub(channel)
+        # Predict
+        req = sv.PredictRequest()
+        req.model_spec.name = "hpt"
+        req.model_spec.version.value = 1
+        req.inputs["x"].dtype = 1
+        req.inputs["x"].tensor_shape.dim.add(size=2)
+        req.inputs["x"].float_val.extend([4.0, 8.0])
+        resp = await stub.method(PREDICTION_SERVICE, "Predict")(req)
+        out = np.frombuffer(resp.outputs["y"].tensor_content, dtype=np.float32)
+        np.testing.assert_allclose(out, [4.0, 6.0])
+        assert resp.model_spec.version.value == 1
+        # Predict with no version -> resolved
+        req2 = sv.PredictRequest()
+        req2.model_spec.name = "hpt"
+        req2.inputs["x"].dtype = 1
+        req2.inputs["x"].tensor_shape.dim.add(size=1)
+        req2.inputs["x"].float_val.append(0.0)
+        resp2 = await stub.method(PREDICTION_SERVICE, "Predict")(req2)
+        assert resp2.model_spec.version.value == 1
+        # GetModelMetadata
+        mreq = sv.GetModelMetadataRequest()
+        mreq.model_spec.name = "hpt"
+        mresp = await stub.method(PREDICTION_SERVICE, "GetModelMetadata")(mreq)
+        sdm = sv.SignatureDefMap()
+        assert mresp.metadata["signature_def"].Unpack(sdm)
+        assert "x" in sdm.signature_def["serving_default"].inputs
+        # ModelService status
+        sreq = sv.GetModelStatusRequest()
+        sreq.model_spec.name = "hpt"
+        sresp = await stub.method(MODEL_SERVICE, "GetModelStatus")(sreq)
+        assert sresp.model_version_status[0].state == sv.ModelVersionStatus.AVAILABLE
+        # SessionRun
+        srun = sv.SessionRunRequest()
+        srun.model_spec.name = "hpt"
+        f = srun.feed.add()
+        f.name = "x:0"
+        f.tensor.dtype = 1
+        f.tensor.tensor_shape.dim.add(size=1)
+        f.tensor.float_val.append(2.0)
+        srun.fetch.append("y:0")
+        sresp2 = await stub.method(SESSION_SERVICE, "SessionRun")(srun)
+        assert sresp2.tensor[0].name == "y:0"
+        np.testing.assert_allclose(
+            np.frombuffer(sresp2.tensor[0].tensor.tensor_content, np.float32), [3.0]
+        )
+        await channel.close()
+
+
+async def test_reload_config_prefetch(tmp_path):
+    async with single_node(
+        tmp_path, families=(("half_plus_two", "hpt", 1), ("half_plus_two", "hpt2", 4))
+    ) as (_, gport, manager, _):
+        channel = make_channel(f"127.0.0.1:{gport}")
+        stub = ServingStub(channel)
+        req = sv.ReloadConfigRequest()
+        mc = req.config.model_config_list.config.add()
+        mc.name = "hpt2"
+        mc.model_version_policy.specific.versions.append(4)
+        resp = await stub.method(MODEL_SERVICE, "HandleReloadConfigRequest")(req)
+        assert resp.status.error_code == 0
+        from tfservingcache_tpu.types import ModelId
+
+        assert manager.runtime.is_loaded(ModelId("hpt2", 4))
+        await channel.close()
+
+
+async def test_mnist_classify_rest_and_grpc(tmp_path):
+    async with single_node(tmp_path, families=(("mnist_cnn", "mn", 1),)) as (
+        rport,
+        gport,
+        _,
+        _,
+    ):
+        base = f"http://127.0.0.1:{rport}"
+        img = np.zeros((28, 28, 1), np.float32).tolist()
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/models/mn/versions/1:predict",
+                json={"instances": [{"image": img}]},
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+                data = await resp.json()
+        row = data["predictions"][0]
+        assert len(row["logits"]) == 10 and isinstance(row["classes"], int)

@@ -13,6 +13,7 @@ the actual joined directory tree.
 from __future__ import annotations
 
 import os
+import queue
 import shutil
 import threading
 from contextlib import contextmanager
@@ -55,6 +56,15 @@ class ModelDiskCache:
         # rmtree must not race a concurrent re-fetch writing the same path.
         self._key_locks: dict[ModelId, threading.Lock] = {}
         self._key_locks_guard = threading.Lock()
+        # Evictions run on one dedicated worker so the thread that *caused*
+        # an eviction (holding its own model's fetch_lock) never blocks on
+        # another model's key lock — two concurrent misses evicting each
+        # other's models would otherwise ABBA-deadlock.
+        self._evict_queue: queue.Queue = queue.Queue()
+        self._evict_worker = threading.Thread(
+            target=self._evict_loop, name="tpusc-disk-evict", daemon=True
+        )
+        self._evict_worker.start()
         if recover:
             self._recover_index()
 
@@ -106,6 +116,25 @@ class ModelDiskCache:
 
     # -- internals ----------------------------------------------------------
     def _evict(self, model_id: ModelId, entry: LRUEntry[Model]) -> None:
+        self._evict_queue.put((model_id, entry))
+
+    def _evict_loop(self) -> None:
+        while True:
+            item = self._evict_queue.get()
+            try:
+                if item is None:
+                    return
+                self._evict_impl(*item)
+            except Exception:  # noqa: BLE001 - worker must survive bad evictions
+                log.exception("eviction failed")
+            finally:
+                self._evict_queue.task_done()
+
+    def drain_evictions(self) -> None:
+        """Block until all queued evictions have completed (tests, shutdown)."""
+        self._evict_queue.join()
+
+    def _evict_impl(self, model_id: ModelId, entry: LRUEntry[Model]) -> None:
         with self._key_locks_guard:
             lock = self._key_locks.setdefault(model_id, threading.Lock())
         with lock:

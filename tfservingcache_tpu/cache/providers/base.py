@@ -56,3 +56,10 @@ class ModelProvider(abc.ABC):
     @abc.abstractmethod
     def check(self) -> None:
         """Health probe; raise ProviderError when the backing store is down."""
+
+    def latest_version(self, name: str) -> int:
+        """Highest stored version of ``name`` (serves requests that omit the
+        version). Providers that can list versions must override this."""
+        raise ModelNotFoundError(
+            f"provider {type(self).__name__} cannot resolve a latest version for {name!r}"
+        )

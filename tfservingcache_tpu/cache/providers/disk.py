@@ -53,6 +53,22 @@ class DiskModelProvider(ModelProvider):
             size_on_disk=dir_size_bytes(dest_dir),
         )
 
+    def latest_version(self, name: str) -> int:
+        """Highest numeric version dir (used when clients omit the version)."""
+        model_dir = os.path.join(self.base_dir, name)
+        if not os.path.isdir(model_dir):
+            raise ModelNotFoundError(f"model dir not found: {model_dir}")
+        versions = []
+        for entry in os.listdir(model_dir):
+            try:
+                if os.path.isdir(os.path.join(model_dir, entry)):
+                    versions.append(int(entry))
+            except ValueError:
+                continue
+        if not versions:
+            raise ModelNotFoundError(f"no versions of model {name!r} in {model_dir}")
+        return max(versions)
+
     def model_size(self, name: str, version: int) -> int:
         return dir_size_bytes(self._find_src_path(name, version))
 

@@ -1,0 +1,41 @@
+"""half_plus_two — the canonical TF Serving smoke-test model
+(BASELINE.json config #1), as a native JAX family: y = w*x + b with
+w=0.5, b=2 at export time. Trivial on purpose: it exercises the whole
+fetch->compile->pin->predict path with negligible compile cost.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, register
+
+
+@register("half_plus_two")
+def build(config: dict) -> ModelDef:
+    def apply(params, inputs):
+        x = inputs["x"]
+        return {"y": params["w"] * x + params["b"]}
+
+    def init(rng):
+        del rng
+        return {"w": jnp.float32(0.5), "b": jnp.float32(2.0)}
+
+    def loss(params, inputs, targets):
+        pred = apply(params, inputs)["y"]
+        return jnp.mean((pred - targets["y"]) ** 2)
+
+    return ModelDef(
+        family="half_plus_two",
+        config=config,
+        apply=apply,
+        init=init,
+        input_spec={"x": TensorSpec("float32", (-1,))},
+        output_spec={"y": TensorSpec("float32", (-1,))},
+        loss=loss,
+    )
+
+
+def reference_output(x: np.ndarray) -> np.ndarray:
+    return 0.5 * np.asarray(x, np.float32) + 2.0

@@ -1,0 +1,66 @@
+"""MNIST CNN family (BASELINE.json config #2: 10 tenant copies exercising
+LRU eviction). A small flax convnet; conv + matmul work lands on the MXU,
+params are a few hundred KB so many tenants fit in HBM.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, register
+
+
+class _CNN(nn.Module):
+    num_classes: int = 10
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        # NHWC input; compute in bf16, accumulate/logits in f32 (TPU-friendly)
+        x = x.astype(jnp.bfloat16)
+        x = nn.Conv(self.width, (3, 3), padding="SAME", dtype=jnp.bfloat16)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.width * 2, (3, 3), padding="SAME", dtype=jnp.bfloat16)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=jnp.bfloat16)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+@register("mnist_cnn", {"num_classes": 10, "width": 32})
+def build(config: dict) -> ModelDef:
+    module = _CNN(num_classes=config["num_classes"], width=config["width"])
+
+    def apply(params, inputs):
+        logits = module.apply({"params": params}, inputs["image"])
+        return {
+            "logits": logits,
+            "classes": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+        }
+
+    def init(rng):
+        return module.init(rng, jnp.zeros((1, 28, 28, 1), jnp.float32))["params"]
+
+    def loss(params, inputs, targets):
+        logits = module.apply({"params": params}, inputs["image"])
+        labels = jax.nn.one_hot(targets["label"], config["num_classes"])
+        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+
+    return ModelDef(
+        family="mnist_cnn",
+        config=config,
+        apply=apply,
+        init=init,
+        input_spec={"image": TensorSpec("float32", (-1, 28, 28, 1))},
+        output_spec={
+            "logits": TensorSpec("float32", (-1, config["num_classes"])),
+            "classes": TensorSpec("int32", (-1,)),
+        },
+        loss=loss,
+    )

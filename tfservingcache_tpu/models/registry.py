@@ -1,0 +1,171 @@
+"""Model family registry + the TPUSavedModel artifact format.
+
+The reference serves opaque TF SavedModels through an external
+tensorflow_model_server; models here are native JAX modules, stored as a
+versioned artifact directory (same ``<base>/<name>/<version>/`` layout the
+protocol and providers assume — reference diskmodelprovider.go:20-44):
+
+    <name>/<version>/
+      model.json       — {"format": "tpusc.v1", "family": ..., "config": ...}
+      params.msgpack   — flax msgpack of the parameter pytree
+
+``family`` selects a builder registered here; the builder returns a
+``ModelDef`` whose ``apply`` is a pure jittable function — everything the
+runtime compiles and pins to TPU HBM.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+ARTIFACT_FORMAT = "tpusc.v1"
+MODEL_JSON = "model.json"
+PARAMS_FILE = "params.msgpack"
+
+
+@dataclass(frozen=True)
+class TensorSpec:
+    """Shape uses -1 for the dynamic batch dimension."""
+
+    dtype: str
+    shape: tuple[int, ...]
+
+    def np_dtype(self) -> np.dtype:
+        import ml_dtypes  # registered extended dtypes (bfloat16)
+
+        del ml_dtypes
+        return np.dtype(self.dtype)
+
+
+@dataclass
+class ModelDef:
+    """A built, servable model family instance.
+
+    ``apply(params, inputs) -> outputs`` is a pure function over a params
+    pytree and a dict of arrays — the unit of XLA compilation.
+    """
+
+    family: str
+    config: dict[str, Any]
+    apply: Callable[[Any, Mapping[str, Any]], dict[str, Any]]
+    init: Callable[[Any], Any]                      # rng -> params pytree
+    input_spec: dict[str, TensorSpec]
+    output_spec: dict[str, TensorSpec]
+    method_name: str = "tensorflow/serving/predict"
+    # mesh-axis partition rules for multi-chip serving, e.g.
+    # {("dense", "kernel"): (None, "model")}; consumed by parallel.sharding
+    partition_rules: dict[str, Any] = field(default_factory=dict)
+    # loss(params, inputs, targets) for families that support training steps
+    loss: Callable[..., Any] | None = None
+
+
+_REGISTRY: dict[str, Callable[[dict[str, Any]], ModelDef]] = {}
+_DEFAULT_CONFIGS: dict[str, dict[str, Any]] = {}
+
+
+def register(name: str, default_config: dict[str, Any] | None = None):
+    def deco(builder: Callable[[dict[str, Any]], ModelDef]):
+        _REGISTRY[name] = builder
+        _DEFAULT_CONFIGS[name] = default_config or {}
+        return builder
+
+    return deco
+
+
+def families() -> list[str]:
+    _load_builtin_families()
+    return sorted(_REGISTRY)
+
+
+def build(family: str, config: dict[str, Any] | None = None) -> ModelDef:
+    _load_builtin_families()
+    if family not in _REGISTRY:
+        raise KeyError(f"unknown model family {family!r}; known: {families()}")
+    merged = dict(_DEFAULT_CONFIGS[family])
+    merged.update(config or {})
+    return _REGISTRY[family](merged)
+
+
+_BUILTIN_MODULES = ("half_plus_two", "mnist_cnn", "bert", "resnet", "transformer_lm")
+
+
+def _load_builtin_families() -> None:
+    # import for registration side effects; cheap and idempotent
+    import importlib
+
+    for mod in _BUILTIN_MODULES:
+        try:
+            importlib.import_module(f"tfservingcache_tpu.models.{mod}")
+        except ModuleNotFoundError as e:
+            if f"models.{mod}" not in str(e):
+                raise  # a real dependency error inside the module
+
+
+# ---------------------------------------------------------------------------
+# Artifact IO
+# ---------------------------------------------------------------------------
+
+class ArtifactError(Exception):
+    pass
+
+
+def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
+    from flax import serialization
+
+    os.makedirs(dest_dir, exist_ok=True)
+    meta = {
+        "format": ARTIFACT_FORMAT,
+        "family": model.family,
+        "config": model.config,
+        "signature": {
+            "inputs": {k: [v.dtype, list(v.shape)] for k, v in model.input_spec.items()},
+            "outputs": {k: [v.dtype, list(v.shape)] for k, v in model.output_spec.items()},
+            "method_name": model.method_name,
+        },
+    }
+    with open(os.path.join(dest_dir, MODEL_JSON), "w") as f:
+        json.dump(meta, f, indent=1)
+    with open(os.path.join(dest_dir, PARAMS_FILE), "wb") as f:
+        f.write(serialization.to_bytes(params))
+    return dest_dir
+
+
+def load_artifact(path: str) -> tuple[ModelDef, Any]:
+    """-> (ModelDef, params pytree). Raises ArtifactError on malformed dirs."""
+    from flax import serialization
+
+    meta_path = os.path.join(path, MODEL_JSON)
+    if not os.path.exists(meta_path):
+        raise ArtifactError(f"not a TPUSavedModel artifact (no {MODEL_JSON}): {path}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if meta.get("format") != ARTIFACT_FORMAT:
+        raise ArtifactError(f"unsupported artifact format {meta.get('format')!r} in {path}")
+    model = build(meta["family"], meta.get("config"))
+    with open(os.path.join(path, PARAMS_FILE), "rb") as f:
+        # msgpack_restore avoids needing an init()-built template at load time
+        params = serialization.msgpack_restore(f.read())
+    return model, params
+
+
+def export_artifact(
+    family: str,
+    base_dir: str,
+    name: str | None = None,
+    version: int = 1,
+    config: dict[str, Any] | None = None,
+    seed: int = 0,
+) -> str:
+    """Initialize a family with fresh params and write
+    ``<base_dir>/<name>/<version>/`` (used by the CLI, tests and bench)."""
+    import jax
+
+    model = build(family, config)
+    params = model.init(jax.random.PRNGKey(seed))
+    dest = os.path.join(base_dir, name or family, str(version))
+    return save_artifact(dest, model, params)

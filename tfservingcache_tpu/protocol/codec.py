@@ -239,7 +239,13 @@ def encode_predict_json(outputs: Mapping[str, np.ndarray], row_format: bool) -> 
             return {"predictions": _array_to_json(np.asarray(arr))}
         names = list(outputs.keys())
         arrays = {n: np.asarray(a) for n, a in outputs.items()}
-        batch_sizes = {arrays[n].shape[0] if arrays[n].ndim else 1 for n in names}
+        scalars = [n for n in names if arrays[n].ndim == 0]
+        if scalars:
+            raise CodecError(
+                f"0-d output(s) {scalars} cannot be row-encoded; use the columnar "
+                '"inputs" request format for this model'
+            )
+        batch_sizes = {arrays[n].shape[0] for n in names}
         if len(batch_sizes) != 1:
             raise CodecError(f"output batch dims disagree: {batch_sizes}")
         (batch,) = batch_sizes

@@ -1,0 +1,454 @@
+"""LocalServingBackend: the cache node's fulfilment of the serving protocol.
+
+Reference equivalent: the cachemanager's directors + the external TF Serving
+process combined (cachemanager.go:268-309 ensured the model locally then
+rewrote the request at the local tensorflow_model_server; here the request
+is decoded and answered in-process by the JAX runtime — the reference's hot
+path loses one full HTTP/gRPC hop and a process boundary).
+
+JAX work (compile + inference) runs in a thread pool so the asyncio event
+loop keeps serving while the TPU is busy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Mapping
+
+import grpc
+import numpy as np
+
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.base import ModelNotFoundError
+from tfservingcache_tpu.models.registry import TensorSpec
+from tfservingcache_tpu.protocol import codec
+from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
+from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+from tfservingcache_tpu.runtime.base import RuntimeError_
+from tfservingcache_tpu.types import ModelId, ModelState
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("local_backend")
+
+_STATE_NAMES = {s.value: s.name for s in ModelState}
+
+
+def _label_str(v) -> str:
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+_NP_TO_DT_NAME = {
+    "float32": core.DT_FLOAT,
+    "float64": core.DT_DOUBLE,
+    "int32": core.DT_INT32,
+    "int64": core.DT_INT64,
+    "uint8": core.DT_UINT8,
+    "bool": core.DT_BOOL,
+    "float16": core.DT_HALF,
+    "bfloat16": core.DT_BFLOAT16,
+    "object": core.DT_STRING,
+}
+
+
+class LocalServingBackend(ServingBackend):
+    def __init__(self, manager: CacheManager, max_workers: int = 16) -> None:
+        self.manager = manager
+        # JAX dispatch is effectively serialized per device; a few workers
+        # keep fetch/compile of different models overlapping inference.
+        self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="tpusc-serve")
+
+    async def _run(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(self._pool, fn, *args)
+
+    # -- helpers ------------------------------------------------------------
+    def _model_id(self, spec: sv.ModelSpec) -> ModelId:
+        if not spec.name:
+            raise BackendError("model_spec.name is required", grpc.StatusCode.INVALID_ARGUMENT, 400)
+        try:
+            version = self.manager.resolve_version(spec.name, spec.version.value or None)
+        except (KeyError, ModelNotFoundError) as e:
+            raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+        return ModelId(spec.name, version)
+
+    def _predict_sync(
+        self,
+        model_id: ModelId,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: list[str] | None = None,
+    ) -> dict[str, np.ndarray]:
+        try:
+            self.manager.ensure_servable(model_id)
+            return self.manager.runtime.predict(model_id, inputs, output_filter)
+        except ModelNotFoundError as e:
+            raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+        except RuntimeError_ as e:
+            raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
+
+    def _ensure_sync(self, model_id: ModelId) -> None:
+        try:
+            self.manager.ensure_servable(model_id)
+        except ModelNotFoundError as e:
+            raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+        except RuntimeError_ as e:
+            raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 500) from e
+
+    # -- Predict ------------------------------------------------------------
+    async def predict(self, request: sv.PredictRequest) -> sv.PredictResponse:
+        model_id = self._model_id(request.model_spec)
+        try:
+            inputs = {k: codec.tensorproto_to_numpy(v) for k, v in request.inputs.items()}
+        except codec.CodecError as e:
+            raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
+        output_filter = list(request.output_filter) or None
+        outputs = await self._run(self._predict_sync, model_id, inputs, output_filter)
+        resp = sv.PredictResponse()
+        resp.model_spec.name = model_id.name
+        resp.model_spec.version.value = model_id.version
+        if request.model_spec.signature_name:
+            resp.model_spec.signature_name = request.model_spec.signature_name
+        for name, arr in outputs.items():
+            resp.outputs[name].CopyFrom(codec.numpy_to_tensorproto(arr))
+        return resp
+
+    # -- Classify / Regress over tf.Example --------------------------------
+    def _examples_to_inputs(self, inp: sv.Input, spec: Mapping[str, TensorSpec]) -> dict:
+        if inp.WhichOneof("kind") == "example_list_with_context":
+            examples = list(inp.example_list_with_context.examples)
+        else:
+            examples = list(inp.example_list.examples)
+        if not examples:
+            raise BackendError("Input contains no examples", grpc.StatusCode.INVALID_ARGUMENT, 400)
+        columns: dict[str, list[Any]] = {}
+        for ex in examples:
+            for fname, feat in ex.features.feature.items():
+                kind = feat.WhichOneof("kind")
+                if kind == "bytes_list":
+                    val: Any = list(feat.bytes_list.value)
+                elif kind == "float_list":
+                    val = list(feat.float_list.value)
+                elif kind == "int64_list":
+                    val = list(feat.int64_list.value)
+                else:
+                    val = []
+                columns.setdefault(fname, []).append(val[0] if len(val) == 1 else val)
+        arrays: dict[str, np.ndarray] = {}
+        for fname, col in columns.items():
+            s = spec.get(fname)
+            try:
+                if s is not None and s.dtype != "object":
+                    arrays[fname] = np.asarray(col, dtype=s.np_dtype())
+                else:
+                    arrays[fname] = np.asarray(col)
+            except ValueError as e:
+                # ragged feature lists across examples (legal tf.Example,
+                # unservable as a dense tensor) -> client error, not a 500
+                raise BackendError(
+                    f"feature {fname!r} has inconsistent lengths across examples: {e}",
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    400,
+                ) from e
+        return arrays
+
+    def _classify_sync(self, model_id: ModelId, inp: sv.Input) -> sv.ClassificationResult:
+        self._ensure_sync(model_id)
+        in_spec, _, _ = self.manager.runtime.signature(model_id)
+        arrays = self._examples_to_inputs(inp, in_spec)
+        outputs = self.manager.runtime.predict(model_id, arrays)
+        result = sv.ClassificationResult()
+        # scores: prefer explicit "scores", else softmax over "logits"
+        scores = outputs.get("scores")
+        if scores is None and "logits" in outputs:
+            logits = outputs["logits"].astype(np.float64)
+            e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+            scores = e / e.sum(axis=-1, keepdims=True)
+        if scores is None:
+            raise BackendError(
+                f"model {model_id} has no 'scores'/'logits' output for Classify",
+                grpc.StatusCode.FAILED_PRECONDITION,
+                400,
+            )
+        labels = outputs.get("labels")
+        for i, row in enumerate(np.atleast_2d(scores)):
+            cls = result.classifications.add()
+            for j, score in enumerate(row):
+                if labels is None:
+                    label = str(j)
+                elif np.ndim(labels) >= 2:
+                    label = _label_str(labels[i][j])  # per-example label rows
+                else:
+                    label = _label_str(labels[j])     # shared label vector
+                cls.classes.add(label=label, score=float(score))
+        return result
+
+    async def classify(self, request: sv.ClassificationRequest) -> sv.ClassificationResponse:
+        model_id = self._model_id(request.model_spec)
+        result = await self._run(self._classify_sync, model_id, request.input)
+        resp = sv.ClassificationResponse()
+        resp.result.CopyFrom(result)
+        resp.model_spec.name = model_id.name
+        resp.model_spec.version.value = model_id.version
+        return resp
+
+    def _regress_sync(self, model_id: ModelId, inp: sv.Input) -> sv.RegressionResult:
+        self._ensure_sync(model_id)
+        in_spec, out_spec, _ = self.manager.runtime.signature(model_id)
+        arrays = self._examples_to_inputs(inp, in_spec)
+        outputs = self.manager.runtime.predict(model_id, arrays)
+        name = "outputs" if "outputs" in outputs else next(iter(out_spec))
+        vals = np.asarray(outputs[name], dtype=np.float64).reshape(-1)
+        result = sv.RegressionResult()
+        for v in vals:
+            result.regressions.add(value=float(v))
+        return result
+
+    async def regress(self, request: sv.RegressionRequest) -> sv.RegressionResponse:
+        model_id = self._model_id(request.model_spec)
+        result = await self._run(self._regress_sync, model_id, request.input)
+        resp = sv.RegressionResponse()
+        resp.result.CopyFrom(result)
+        resp.model_spec.name = model_id.name
+        resp.model_spec.version.value = model_id.version
+        return resp
+
+    # -- metadata / status / reload -----------------------------------------
+    def _signature_def(self, model_id: ModelId) -> core.SignatureDef:
+        in_spec, out_spec, method = self.manager.runtime.signature(model_id)
+        sig = core.SignatureDef(method_name=method)
+
+        def fill(target, spec: Mapping[str, TensorSpec]):
+            for name, s in spec.items():
+                info = target[name]
+                info.name = f"{name}:0"
+                info.dtype = _NP_TO_DT_NAME.get(s.dtype, core.DT_INVALID)
+                for d in s.shape:
+                    info.tensor_shape.dim.add(size=d)
+
+        fill(sig.inputs, in_spec)
+        fill(sig.outputs, out_spec)
+        return sig
+
+    async def get_model_metadata(
+        self, request: sv.GetModelMetadataRequest
+    ) -> sv.GetModelMetadataResponse:
+        model_id = self._model_id(request.model_spec)
+        await self._run(self._ensure_sync, model_id)
+        sig = self._signature_def(model_id)
+        resp = sv.GetModelMetadataResponse()
+        resp.model_spec.name = model_id.name
+        resp.model_spec.version.value = model_id.version
+        sdm = sv.SignatureDefMap()
+        sdm.signature_def["serving_default"].CopyFrom(sig)
+        resp.metadata["signature_def"].Pack(sdm)
+        return resp
+
+    async def get_model_status(
+        self, request: sv.GetModelStatusRequest
+    ) -> sv.GetModelStatusResponse:
+        name = request.model_spec.name
+        states = self.manager.runtime.states_for(name)
+        want_version = request.model_spec.version.value
+        resp = sv.GetModelStatusResponse()
+        for mid, state in sorted(states.items()):
+            if want_version and mid.version != want_version:
+                continue
+            s = resp.model_version_status.add()
+            s.version = mid.version
+            s.state = int(state)
+        if not resp.model_version_status:
+            # also report disk-cached (not yet loaded) versions as START
+            for mid in self.manager.list_cached():
+                if mid.name == name and (not want_version or mid.version == want_version):
+                    s = resp.model_version_status.add()
+                    s.version = mid.version
+                    s.state = int(ModelState.START)
+        if not resp.model_version_status:
+            raise BackendError(
+                f"model {name!r} not found", grpc.StatusCode.NOT_FOUND, 404
+            )
+        return resp
+
+    async def reload_config(self, request: sv.ReloadConfigRequest) -> sv.ReloadConfigResponse:
+        """Desired-state prefetch: every (name, specific version) in the config
+        is made servable (the reference forwards this shape to TF Serving —
+        servingcontroller.go:88-112; here it doubles as a warm-up API)."""
+        targets: list[ModelId] = []
+        for mc in request.config.model_config_list.config:
+            versions = list(mc.model_version_policy.specific.versions) or [0]
+            for v in versions:
+                try:
+                    targets.append(ModelId(mc.name, self.manager.resolve_version(mc.name, v or None)))
+                except (KeyError, ModelNotFoundError) as e:
+                    resp = sv.ReloadConfigResponse()
+                    resp.status.error_code = 5  # NOT_FOUND
+                    resp.status.error_message = str(e)
+                    return resp
+        results = await asyncio.gather(
+            *(self._run(self._ensure_sync, t) for t in targets), return_exceptions=True
+        )
+        resp = sv.ReloadConfigResponse()
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            resp.status.error_code = 13  # INTERNAL
+            resp.status.error_message = "; ".join(str(e) for e in errors[:3])
+        return resp
+
+    # -- SessionService -----------------------------------------------------
+    async def session_run(self, request: sv.SessionRunRequest) -> sv.SessionRunResponse:
+        model_id = self._model_id(request.model_spec)
+
+        def run() -> dict[str, np.ndarray]:
+            self._ensure_sync(model_id)
+            inputs = {
+                f.name.split(":")[0]: codec.tensorproto_to_numpy(f.tensor)
+                for f in request.feed
+            }
+            fetch = [f.split(":")[0] for f in request.fetch] or None
+            return self.manager.runtime.predict(model_id, inputs, fetch)
+
+        outputs = await self._run(run)
+        resp = sv.SessionRunResponse()
+        for name, arr in outputs.items():
+            t = resp.tensor.add()
+            t.name = f"{name}:0"
+            t.tensor.CopyFrom(codec.numpy_to_tensorproto(arr))
+        return resp
+
+    # -- REST ---------------------------------------------------------------
+    async def handle_rest(
+        self,
+        method: str,
+        model_name: str,
+        version: int | None,
+        verb: str | None,
+        body: bytes,
+    ) -> RestResponse:
+        try:
+            resolved = self.manager.resolve_version(model_name, version)
+        except (KeyError, ModelNotFoundError) as e:
+            raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+        model_id = ModelId(model_name, resolved)
+
+        if method == "GET" and verb is None:
+            return await self._rest_status(model_id)
+        if method == "GET" and verb == "metadata":
+            return await self._rest_metadata(model_id)
+        if method != "POST" or verb not in ("predict", "classify", "regress"):
+            raise BackendError(
+                f"unsupported {method} {verb or ''} request", grpc.StatusCode.UNIMPLEMENTED, 405
+            )
+        try:
+            payload = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            raise BackendError(f"invalid JSON body: {e}", grpc.StatusCode.INVALID_ARGUMENT, 400) from e
+
+        if verb == "predict":
+            return await self._rest_predict(model_id, payload)
+        return await self._rest_classify_regress(model_id, verb, payload)
+
+    async def _rest_predict(self, model_id: ModelId, payload: dict) -> RestResponse:
+        def run() -> tuple[dict[str, np.ndarray], bool]:
+            self._ensure_sync(model_id)
+            in_spec, _, _ = self.manager.runtime.signature(model_id)
+            dtypes = {k: s.np_dtype() for k, s in in_spec.items()}
+            if len(dtypes) == 1:
+                default_input = next(iter(dtypes))
+            else:
+                default_input = "inputs"
+            try:
+                arrays, _sig = codec.decode_predict_json(payload, dtypes, default_input)
+            except codec.CodecError as e:
+                raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
+            row = "instances" in payload
+            return self.manager.runtime.predict(model_id, arrays), row
+
+        outputs, row = await self._run(lambda: run())
+        try:
+            body = json.dumps(codec.encode_predict_json(outputs, row_format=row)).encode()
+        except codec.CodecError as e:
+            raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
+        return RestResponse(status=200, body=body)
+
+    async def _rest_classify_regress(
+        self, model_id: ModelId, verb: str, payload: dict
+    ) -> RestResponse:
+        examples = payload.get("examples")
+        if not isinstance(examples, list) or not examples:
+            raise BackendError(
+                '"examples" must be a non-empty list', grpc.StatusCode.INVALID_ARGUMENT, 400
+            )
+        inp = sv.Input()
+        for ex in examples:
+            pb_ex = inp.example_list.examples.add()
+            for fname, val in ex.items():
+                feat = pb_ex.features.feature[fname]
+                vals = val if isinstance(val, list) else [val]
+                if all(isinstance(v, (int, np.integer)) for v in vals):
+                    feat.int64_list.value.extend(int(v) for v in vals)
+                elif all(isinstance(v, (int, float, np.floating)) for v in vals):
+                    feat.float_list.value.extend(float(v) for v in vals)
+                else:
+                    feat.bytes_list.value.extend(
+                        v.encode() if isinstance(v, str) else bytes(v) for v in vals
+                    )
+        if verb == "classify":
+            result = await self._run(self._classify_sync, model_id, inp)
+            rows = [
+                [[c.label, c.score] for c in cls.classes]
+                for cls in result.classifications
+            ]
+            return RestResponse(status=200, body=json.dumps({"results": rows}).encode())
+        result = await self._run(self._regress_sync, model_id, inp)
+        vals = [r.value for r in result.regressions]
+        return RestResponse(status=200, body=json.dumps({"results": vals}).encode())
+
+    async def _rest_status(self, model_id: ModelId) -> RestResponse:
+        req = sv.GetModelStatusRequest()
+        req.model_spec.name = model_id.name
+        req.model_spec.version.value = model_id.version
+        resp = await self.get_model_status(req)
+        out = {
+            "model_version_status": [
+                {
+                    "version": str(s.version),
+                    "state": _STATE_NAMES.get(s.state, "UNKNOWN"),
+                    "status": {"error_code": "OK", "error_message": ""},
+                }
+                for s in resp.model_version_status
+            ]
+        }
+        return RestResponse(status=200, body=json.dumps(out).encode())
+
+    async def _rest_metadata(self, model_id: ModelId) -> RestResponse:
+        await self._run(self._ensure_sync, model_id)
+        in_spec, out_spec, method_name = self.manager.runtime.signature(model_id)
+
+        def render(spec: Mapping[str, TensorSpec]) -> dict:
+            return {
+                name: {
+                    "dtype": s.dtype,
+                    "tensor_shape": {"dim": [{"size": str(d)} for d in s.shape]},
+                    "name": f"{name}:0",
+                }
+                for name, s in spec.items()
+            }
+
+        out = {
+            "model_spec": {"name": model_id.name, "version": str(model_id.version)},
+            "metadata": {
+                "signature_def": {
+                    "signature_def": {
+                        "serving_default": {
+                            "inputs": render(in_spec),
+                            "outputs": render(out_spec),
+                            "method_name": method_name,
+                        }
+                    }
+                }
+            },
+        }
+        return RestResponse(status=200, body=json.dumps(out).encode())
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,4 @@
+from tfservingcache_tpu.runtime.base import BaseRuntime, RuntimeError_
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+__all__ = ["BaseRuntime", "RuntimeError_", "TPUModelRuntime"]

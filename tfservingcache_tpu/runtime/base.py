@@ -1,0 +1,87 @@
+"""Runtime interface.
+
+Replaces the reference's TFServingController + external tensorflow_model_server
+(pkg/cachemanager/servingcontroller.go:88-157): instead of desired-state
+ReloadConfig RPCs against another process, the cache node drives an
+in-process runtime with direct load/unload/predict calls. The lifecycle
+state machine (START/LOADING/AVAILABLE/UNLOADING/END) is TF's
+ModelVersionStatus enum, now tracked in-process (servingcontroller.go:29-54).
+
+Methods are synchronous and thread-safe; async protocol backends call them
+through an executor so JAX compile/infer never blocks the event loop.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Mapping
+
+import numpy as np
+
+from tfservingcache_tpu.models.registry import TensorSpec
+from tfservingcache_tpu.types import Model, ModelId, ModelState
+
+
+class RuntimeError_(Exception):
+    """Runtime failure (load/predict). Underscore avoids shadowing builtins."""
+
+
+class ModelNotLoadedError(RuntimeError_):
+    pass
+
+
+class BaseRuntime(abc.ABC):
+    def __init__(self) -> None:
+        self._states: dict[ModelId, ModelState] = {}
+        self._states_lock = threading.Lock()
+
+    # -- state machine ------------------------------------------------------
+    def _set_state(self, model_id: ModelId, state: ModelState) -> None:
+        with self._states_lock:
+            self._states[model_id] = state
+
+    def state(self, model_id: ModelId) -> ModelState:
+        with self._states_lock:
+            return self._states.get(model_id, ModelState.UNKNOWN)
+
+    def states_for(self, name: str) -> dict[ModelId, ModelState]:
+        """All known versions of ``name`` (the ModelService status view;
+        reference GetModelStates, servingcontroller.go:140-157)."""
+        with self._states_lock:
+            return {m: s for m, s in self._states.items() if m.name == name}
+
+    # -- core ---------------------------------------------------------------
+    @abc.abstractmethod
+    def ensure_loaded(self, model: Model) -> None:
+        """Make ``model`` servable (idempotent); blocks until AVAILABLE or
+        raises. The artifact is already on local disk at ``model.path``."""
+
+    @abc.abstractmethod
+    def is_loaded(self, model_id: ModelId) -> bool: ...
+
+    @abc.abstractmethod
+    def predict(
+        self,
+        model_id: ModelId,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: list[str] | None = None,
+    ) -> dict[str, np.ndarray]: ...
+
+    @abc.abstractmethod
+    def unload(self, model_id: ModelId) -> None: ...
+
+    @abc.abstractmethod
+    def signature(self, model_id: ModelId) -> tuple[dict[str, TensorSpec], dict[str, TensorSpec], str]:
+        """-> (input_spec, output_spec, method_name) for a loaded model."""
+
+    @abc.abstractmethod
+    def check(self) -> None:
+        """Raise when the runtime/accelerator is unhealthy."""
+
+    @property
+    @abc.abstractmethod
+    def hbm_bytes_in_use(self) -> int: ...
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass

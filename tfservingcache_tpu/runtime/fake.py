@@ -1,0 +1,112 @@
+"""FakeRuntime — the in-process test double for the model runtime.
+
+SURVEY.md §4's core lesson: the reference could never test its
+fetch/evict/reload state machine because the backend lived in another
+process; this fake makes the CacheManager's most subtle code testable
+(configurable latency/failures, call recording, real state transitions).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Mapping
+
+import numpy as np
+
+from tfservingcache_tpu.models.registry import TensorSpec
+from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
+from tfservingcache_tpu.types import Model, ModelId, ModelState
+
+
+class FakeRuntime(BaseRuntime):
+    """predict(x) = x * version + bias, so tests can tell versions apart."""
+
+    def __init__(
+        self,
+        load_delay_s: float = 0.0,
+        fail_loads: set[ModelId] | None = None,
+        bias: float = 0.0,
+        max_loaded: int | None = None,
+    ) -> None:
+        super().__init__()
+        self.load_delay_s = load_delay_s
+        self.fail_loads = fail_loads or set()
+        self.bias = bias
+        self.max_loaded = max_loaded
+        self.loads: list[ModelId] = []
+        self.unloads: list[ModelId] = []
+        self.predicts: list[ModelId] = []
+        self.concurrent_loads = 0
+        self.max_concurrent_loads = 0
+        self._loaded: dict[ModelId, Model] = {}
+        self._lock = threading.Lock()
+
+    def ensure_loaded(self, model: Model) -> None:
+        mid = model.identifier
+        with self._lock:
+            if mid in self._loaded:
+                return
+            self.concurrent_loads += 1
+            self.max_concurrent_loads = max(self.max_concurrent_loads, self.concurrent_loads)
+            self._set_state(mid, ModelState.LOADING)
+        try:
+            if self.load_delay_s:
+                time.sleep(self.load_delay_s)
+            if mid in self.fail_loads:
+                self._set_state(mid, ModelState.END)
+                raise RuntimeError_(f"fake load failure for {mid}")
+            with self._lock:
+                if self.max_loaded is not None and len(self._loaded) >= self.max_loaded:
+                    lru = next(iter(self._loaded))
+                    del self._loaded[lru]
+                    self.unloads.append(lru)
+                    self._set_state(lru, ModelState.END)
+                self._loaded[mid] = model
+                self.loads.append(mid)
+                self._set_state(mid, ModelState.AVAILABLE)
+        finally:
+            with self._lock:
+                self.concurrent_loads -= 1
+
+    def is_loaded(self, model_id: ModelId) -> bool:
+        with self._lock:
+            return model_id in self._loaded
+
+    def predict(
+        self,
+        model_id: ModelId,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: list[str] | None = None,
+    ) -> dict[str, np.ndarray]:
+        if not self.is_loaded(model_id):
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        self.predicts.append(model_id)
+        x = np.asarray(inputs["x"], dtype=np.float32)
+        out = {"y": x * model_id.version + self.bias}
+        if output_filter:
+            out = {k: v for k, v in out.items() if k in output_filter}
+        return out
+
+    def unload(self, model_id: ModelId) -> None:
+        with self._lock:
+            if model_id in self._loaded:
+                del self._loaded[model_id]
+                self.unloads.append(model_id)
+                self._set_state(model_id, ModelState.END)
+
+    def signature(self, model_id: ModelId):
+        if not self.is_loaded(model_id):
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        return (
+            {"x": TensorSpec("float32", (-1,))},
+            {"y": TensorSpec("float32", (-1,))},
+            "tensorflow/serving/predict",
+        )
+
+    def check(self) -> None:
+        pass
+
+    @property
+    def hbm_bytes_in_use(self) -> int:
+        return sum(m.size_on_disk for m in self._loaded.values())

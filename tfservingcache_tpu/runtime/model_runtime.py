@@ -1,0 +1,272 @@
+"""The TPU model runtime: artifact -> JAX fn -> XLA executable pinned in HBM.
+
+This is the component that dissolves the reference's L1 process boundary
+(SURVEY.md §7 design stance): where the reference POSTs a desired-state
+ReloadConfigRequest to tensorflow_model_server and polls GetModelStatus every
+500 ms until AVAILABLE (cachemanager.go:167-195), this runtime loads the
+artifact, ``jit``-compiles the family's apply fn, runs a warmup call to
+materialize the executable + params in HBM, and flips the state machine to
+AVAILABLE — all in-process, nothing to poll.
+
+HBM is the scarce resource (the reference only budgets disk bytes —
+SURVEY.md §7 hard part (b)); resident models live in a byte-budgeted LRU
+whose eviction drops executable + param references so XLA frees device
+memory.
+
+Variable request batch sizes are padded up to power-of-two buckets so each
+model compiles O(log max_batch) executables instead of one per batch size —
+dynamic shapes would otherwise force an XLA recompile per novel batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+import numpy as np
+
+from tfservingcache_tpu.cache.lru import LRUCache, LRUEntry
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, load_artifact
+from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
+from tfservingcache_tpu.types import Model, ModelId, ModelState
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.metrics import Metrics
+
+log = get_logger("runtime")
+
+
+def next_bucket(n: int) -> int:
+    """Smallest power of two >= n (batch padding bucket)."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def tree_nbytes(tree: Any) -> int:
+    import jax
+
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "nbytes"))
+
+
+@dataclass
+class LoadedModel:
+    model_def: ModelDef
+    params: Any                      # device-resident pytree
+    jitted: Any                      # jax.jit-wrapped apply
+    hbm_bytes: int
+    load_lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class TPUModelRuntime(BaseRuntime):
+    def __init__(
+        self,
+        cfg: ServingConfig | None = None,
+        metrics: Metrics | None = None,
+        mesh: Any | None = None,
+    ) -> None:
+        super().__init__()
+        import jax
+
+        self.cfg = cfg or ServingConfig()
+        self.metrics = metrics
+        self.mesh = mesh  # jax.sharding.Mesh for multi-chip models (parallel/)
+        if self.cfg.compile_cache_dir:
+            # persistent XLA compile cache: restart != recompile-the-world
+            # (SURVEY.md §5 checkpoint/resume note)
+            jax.config.update("jax_compilation_cache_dir", self.cfg.compile_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        self._devices = jax.devices(self.cfg.platform or None)
+        self._resident: LRUCache[ModelId, LoadedModel] = LRUCache(
+            self.cfg.hbm_capacity_bytes,
+            on_evict=self._on_evict,
+            max_items=self.cfg.max_concurrent_models,
+        )
+        self._load_locks: dict[ModelId, threading.Lock] = {}
+        self._load_locks_guard = threading.Lock()
+
+    # -- load ---------------------------------------------------------------
+    def ensure_loaded(self, model: Model) -> None:
+        mid = model.identifier
+        if self.is_loaded(mid):
+            return
+        with self._load_locks_guard:
+            lock = self._load_locks.setdefault(mid, threading.Lock())
+        with lock:
+            if self.is_loaded(mid):  # singleflight: someone else finished it
+                return
+            self._load(model)
+
+    def _load(self, model: Model) -> None:
+        import jax
+
+        mid = model.identifier
+        self._set_state(mid, ModelState.START)
+        t0 = time.monotonic()
+        try:
+            self._set_state(mid, ModelState.LOADING)
+            model_def, host_params = load_artifact(model.path)
+            params = jax.device_put(host_params, self._devices[0])
+            jitted = jax.jit(model_def.apply)
+            hbm = tree_nbytes(params)
+            loaded = LoadedModel(model_def, params, jitted, hbm)
+            if self.cfg.warmup:
+                self._warmup(loaded)
+            self._resident.put(mid, hbm, loaded)
+            self._set_state(mid, ModelState.AVAILABLE)
+        except Exception as e:
+            self._set_state(mid, ModelState.END)
+            raise RuntimeError_(f"failed to load {mid}: {e}") from e
+        dt = time.monotonic() - t0
+        if self.metrics is not None:
+            self.metrics.compile_duration.labels(
+                self.metrics.model_label(mid.name, mid.version)
+            ).observe(dt)
+            self._update_gauges()
+        log.info("loaded %s in %.2fs (%d HBM bytes)", mid, dt, hbm)
+
+    def _warmup(self, loaded: LoadedModel) -> None:
+        """One tiny call per model at load: compiles the bucket-1 executable
+        and pins params before the first real request hits."""
+        import jax
+
+        inputs = {
+            name: np.zeros(self._concrete_shape(spec, 1), spec.np_dtype())
+            for name, spec in loaded.model_def.input_spec.items()
+        }
+        out = loaded.jitted(loaded.params, inputs)
+        jax.block_until_ready(out)
+
+    @staticmethod
+    def _concrete_shape(spec: TensorSpec, batch: int) -> tuple[int, ...]:
+        return tuple(batch if d == -1 else d for d in spec.shape)
+
+    # -- predict ------------------------------------------------------------
+    def predict(
+        self,
+        model_id: ModelId,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: list[str] | None = None,
+    ) -> dict[str, np.ndarray]:
+        import jax
+
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        spec = loaded.model_def.input_spec
+        missing = set(spec) - set(inputs)
+        if missing:
+            raise RuntimeError_(f"missing inputs {sorted(missing)} for {model_id}")
+        unknown = set(inputs) - set(spec)
+        if unknown:
+            raise RuntimeError_(f"unknown inputs {sorted(unknown)} for {model_id}")
+
+        batch, padded = self._pad_to_bucket(spec, inputs)
+        out = loaded.jitted(loaded.params, padded)
+        out = jax.device_get(out)
+        out_spec = loaded.model_def.output_spec
+        result: dict[str, np.ndarray] = {}
+        for name, arr in out.items():
+            if output_filter and name not in output_filter:
+                continue
+            arr = np.asarray(arr)
+            if batch is not None:
+                # un-pad only along the axis the output spec marks as batch —
+                # fixed-shape outputs (e.g. a vocab vector) pass through whole
+                ospec = out_spec.get(name)
+                if ospec is not None and -1 in ospec.shape:
+                    axis = ospec.shape.index(-1)
+                    if arr.ndim > axis and arr.shape[axis] >= batch:
+                        arr = np.take(arr, range(batch), axis=axis)
+            result[name] = arr
+        if output_filter and not result:
+            raise RuntimeError_(
+                f"output_filter {output_filter} matches no outputs of {model_id}"
+            )
+        return result
+
+    def _pad_to_bucket(
+        self, spec: Mapping[str, TensorSpec], inputs: Mapping[str, np.ndarray]
+    ) -> tuple[int | None, dict[str, np.ndarray]]:
+        """-> (true batch or None if family is unbatched, padded inputs)."""
+        batch: int | None = None
+        for name, s in spec.items():
+            if -1 in s.shape:
+                arr = np.asarray(inputs[name])
+                axis = s.shape.index(-1)
+                if arr.ndim <= axis:
+                    raise RuntimeError_(
+                        f"input {name!r} needs at least {axis + 1} dims, got shape {arr.shape}"
+                    )
+                b = arr.shape[axis]
+                if batch is not None and b != batch:
+                    raise RuntimeError_(f"inconsistent batch sizes: {batch} vs {b} ({name!r})")
+                batch = b
+        if batch is None:
+            return None, {k: np.asarray(v) for k, v in inputs.items()}
+        bucket = next_bucket(batch)
+        padded: dict[str, np.ndarray] = {}
+        for name, s in spec.items():
+            arr = np.asarray(inputs[name], dtype=s.np_dtype())
+            if -1 in s.shape and bucket != batch:
+                axis = s.shape.index(-1)
+                pad = [(0, 0)] * arr.ndim
+                pad[axis] = (0, bucket - batch)
+                arr = np.pad(arr, pad)
+            padded[name] = arr
+        return batch, padded
+
+    # -- unload / introspection --------------------------------------------
+    def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
+        self._set_state(model_id, ModelState.UNLOADING)
+        # Only the LRU's reference is dropped; in-flight predicts holding the
+        # LoadedModel keep the device arrays alive until they finish, then XLA
+        # frees the HBM when the last reference goes. (Nulling the fields here
+        # would crash those in-flight calls.)
+        self._set_state(model_id, ModelState.END)
+        if self.metrics is not None:
+            self.metrics.evictions.labels("hbm").inc()
+            self._update_gauges()
+        log.info("unloaded %s (freed %d HBM bytes)", model_id, entry.size_bytes)
+
+    def unload(self, model_id: ModelId) -> None:
+        self._resident.remove(model_id, run_callback=True)
+
+    def is_loaded(self, model_id: ModelId) -> bool:
+        return self._resident.get(model_id, touch=False) is not None
+
+    def signature(self, model_id: ModelId):
+        loaded = self._resident.get(model_id, touch=False)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        d = loaded.model_def
+        return d.input_spec, d.output_spec, d.method_name
+
+    def check(self) -> None:
+        """Health probe: the devices must answer a trivial computation
+        (replaces the reference's probe-model GetModelStatus trick,
+        cachemanager.go:76-89 — NOT_FOUND from a live backend = healthy)."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jax.device_put(jnp.ones((8,)), self._devices[0])
+        if float(jnp.sum(x)) != 8.0:
+            raise RuntimeError_("device smoke computation returned wrong result")
+
+    @property
+    def hbm_bytes_in_use(self) -> int:
+        return self._resident.total_bytes
+
+    def resident_models(self) -> list[ModelId]:
+        return self._resident.keys_mru_first()
+
+    def _update_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.hbm_bytes_in_use.set(self._resident.total_bytes)
+        self.metrics.models_resident.set(len(self._resident))
+
+    def close(self) -> None:
+        self._resident.clear()

@@ -1,0 +1,108 @@
+"""Process wiring: build and run a cache node (+ router when discovery is
+configured).
+
+Reference equivalent: cmd/taskhandler/main.go:20-113 — serveCache always
+runs; serveProxy only when ``discovery.type`` is set (main.go:88-105:
+single-node "cache-only" mode otherwise); a 30 s health loop pushes status
+into every gRPC health server (main.go:35-42).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers import create_provider
+from tfservingcache_tpu.config import Config
+from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.metrics import Metrics
+
+log = get_logger("server")
+
+HEALTH_LOOP_PERIOD_S = 30.0  # reference main.go:41
+
+
+class CacheNode:
+    """One serving node: provider -> disk cache -> JAX runtime behind the
+    REST/gRPC protocol servers."""
+
+    def __init__(self, cfg: Config, runtime=None) -> None:
+        self.cfg = cfg
+        self.metrics = Metrics(model_labels=cfg.metrics.model_labels)
+        provider = create_provider(cfg.model_provider)
+        disk_cache = ModelDiskCache(cfg.cache.base_dir, cfg.cache.disk_capacity_bytes)
+        if runtime is None:
+            from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+            runtime = TPUModelRuntime(cfg.serving, self.metrics)
+        self.manager = CacheManager(provider, disk_cache, runtime, self.metrics)
+        self.backend = LocalServingBackend(self.manager)
+        self.rest = RestServingServer(
+            self.backend,
+            self.metrics,
+            require_version=False,
+            metrics_path=cfg.metrics.path,
+        )
+        self.grpc = GrpcServingServer(
+            self.backend, self.metrics, cfg.proxy.grpc_max_message_bytes
+        )
+        self._health_task: asyncio.Task | None = None
+
+    async def start(self) -> tuple[int, int]:
+        rest_port = await self.rest.start(self.cfg.cache_node.rest_port)
+        grpc_port = await self.grpc.start(self.cfg.cache_node.grpc_port)
+        self._health_task = asyncio.create_task(self._health_loop())
+        return rest_port, grpc_port
+
+    def is_healthy(self) -> bool:
+        return self.manager.is_healthy()
+
+    async def _health_loop(self) -> None:
+        while True:
+            healthy = await asyncio.get_running_loop().run_in_executor(None, self.is_healthy)
+            self.grpc.set_health(healthy)
+            await asyncio.sleep(HEALTH_LOOP_PERIOD_S)
+
+    async def close(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+        self.backend.close()
+        await self.rest.close()
+        await self.grpc.close()
+        self.manager.close()
+
+
+async def serve(cfg: Config) -> None:
+    node = CacheNode(cfg)
+    rest_port, grpc_port = await node.start()
+    log.info(
+        "cache node up: REST :%d, gRPC :%d (provider=%s, cache=%s)",
+        rest_port, grpc_port, cfg.model_provider.type, cfg.cache.base_dir,
+    )
+    router = None
+    if cfg.discovery.type:
+        from tfservingcache_tpu.cluster.router import Router
+
+        router = Router(cfg, node)
+        await router.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix
+            pass
+    await stop.wait()
+    log.info("shutting down")
+    if router is not None:
+        await router.close()
+    await node.close()
+
+
+def run_server(cfg: Config) -> None:
+    asyncio.run(serve(cfg))
