@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Headline benchmark: multi-tenant cold-miss load->first-predict latency.
+
+BASELINE.md target: cold-miss p50 <= 2 s (the reference publishes no numbers
+of its own — BASELINE.json `published: {}` — so the target is the bar).
+
+Scenario (BASELINE.json configs #1/#2): N per-tenant model artifacts in a
+disk store; a fresh cache node serves each tenant's first request cold
+(fetch -> compile -> pin to HBM -> predict), then a warm QPS loop on one
+tenant. Prints ONE JSON line:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline = target_s / measured_p50 (>1.0 beats the 2 s target).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import tempfile
+import time
+
+
+def run_bench(family: str, tenants: int, warm_iters: int, batch: int) -> dict:
+    import numpy as np
+
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.models.registry import build, export_artifact
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+    from tfservingcache_tpu.types import ModelId
+
+    tmp = tempfile.mkdtemp(prefix="tpusc-bench-")
+    store = f"{tmp}/store"
+    for i in range(tenants):
+        export_artifact(family, store, name=f"tenant{i}", version=1, seed=i)
+
+    model_def = build(family)
+    rng = np.random.default_rng(0)
+    inputs = {
+        name: rng.normal(size=tuple(batch if d == -1 else d for d in spec.shape)).astype(
+            spec.np_dtype()
+        )
+        for name, spec in model_def.input_spec.items()
+    }
+
+    provider = DiskModelProvider(store)
+    cache = ModelDiskCache(f"{tmp}/cache", capacity_bytes=64 << 30)
+    runtime = TPUModelRuntime(
+        ServingConfig(hbm_capacity_bytes=8 << 30, max_concurrent_models=max(tenants, 4))
+    )
+    manager = CacheManager(provider, cache, runtime)
+
+    cold_times = []
+    for i in range(tenants):
+        mid = ModelId(f"tenant{i}", 1)
+        t0 = time.perf_counter()
+        manager.ensure_servable(mid)
+        out = runtime.predict(mid, inputs)
+        _ = {k: np.asarray(v) for k, v in out.items()}
+        cold_times.append(time.perf_counter() - t0)
+
+    # warm QPS on tenant 0
+    mid = ModelId("tenant0", 1)
+    runtime.predict(mid, inputs)  # ensure warm
+    t0 = time.perf_counter()
+    for _ in range(warm_iters):
+        runtime.predict(mid, inputs)
+    warm_dt = time.perf_counter() - t0
+    warm_qps = warm_iters * batch / warm_dt
+
+    p50 = statistics.median(cold_times)
+    return {
+        "cold_p50_s": p50,
+        "cold_p95_s": sorted(cold_times)[int(0.95 * (len(cold_times) - 1))],
+        "cold_first_s": cold_times[0],
+        "warm_qps": warm_qps,
+        "warm_ms_per_req": warm_dt / warm_iters * 1e3,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--family", default="mnist_cnn")
+    parser.add_argument("--tenants", type=int, default=8)
+    parser.add_argument("--warm-iters", type=int, default=200)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--target-s", type=float, default=2.0)
+    args = parser.parse_args()
+
+    stats = run_bench(args.family, args.tenants, args.warm_iters, args.batch)
+    print(
+        json.dumps(
+            {
+                "metric": f"cold_miss_load_to_first_predict_p50 ({args.family}, "
+                f"{args.tenants} tenants; warm {stats['warm_qps']:.0f} qps)",
+                "value": round(stats["cold_p50_s"], 4),
+                "unit": "s",
+                "vs_baseline": round(args.target_s / stats["cold_p50_s"], 3),
+            }
+        )
+    )
+    print(json.dumps({"detail": {k: round(v, 4) for k, v in stats.items()}}), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

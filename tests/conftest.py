@@ -5,11 +5,19 @@ strategy — pkg/taskhandler/cluster_test.go:12-49)."""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before any jax backend initialization. The image pins
+# JAX_PLATFORMS=axon (the real TPU tunnel), and empirically the axon plugin
+# wins over a JAX_PLATFORMS=cpu env var set before import — only
+# jax.config.update("jax_platforms", "cpu") reliably forces CPU here, so the
+# eager jax import below is load-bearing, not belt-and-suspenders.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
