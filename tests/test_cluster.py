@@ -1,0 +1,211 @@
+"""ClusterConnection + discovery tests using the in-process mock pattern
+(reference DiscoveryServiceMock, cluster_test.go:12-49) and the file
+backend; plus a full 3-node routed e2e with failover."""
+
+import asyncio
+import json
+from contextlib import asynccontextmanager
+
+import aiohttp
+import grpc
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.cluster.cluster import ClusterConnection
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.cluster.discovery.filewatch import FileDiscoveryService
+from tfservingcache_tpu.cluster.router import RoutingBackend
+from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
+from tfservingcache_tpu.protocol.grpc_server import PREDICTION_SERVICE, GrpcServingServer
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.protocol import codec
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+from tfservingcache_tpu.runtime.fake import FakeRuntime
+from tfservingcache_tpu.types import NodeInfo
+
+
+class DiscoveryServiceMock(DiscoveryService):
+    """Push synthetic membership (reference GenerateMembers pattern)."""
+
+    async def register(self, self_node, is_healthy):
+        pass
+
+    async def unregister(self):
+        pass
+
+    def push(self, nodes: list[NodeInfo]) -> None:
+        self._publish(nodes)
+
+
+def nodes_list(n, base_port=9000):
+    return [NodeInfo(f"10.0.0.{i}", base_port + i, base_port + 100 + i) for i in range(n)]
+
+
+async def test_cluster_connection_updates_ring():
+    mock = DiscoveryServiceMock()
+    cluster = ClusterConnection(mock, replicas_per_model=2)
+    self_node = NodeInfo("10.0.0.0", 9000, 9100)
+    connect = asyncio.create_task(cluster.connect(self_node, lambda: True, wait_ready_s=2))
+    await asyncio.sleep(0.05)
+    mock.push(nodes_list(5))
+    await connect
+    assert cluster.node_count == 5
+    found = cluster.find_nodes_for_key("m##1")
+    assert len(found) == 2 and found[0].ident != found[1].ident
+    # membership shrink remaps
+    mock.push(nodes_list(2))
+    await asyncio.sleep(0.05)
+    assert cluster.node_count == 2
+    await cluster.disconnect()
+
+
+async def test_file_discovery_register_watch_unregister(tmp_path):
+    path = str(tmp_path / "members.json")
+    d1 = FileDiscoveryService(path, poll_interval_s=0.05)
+    d2 = FileDiscoveryService(path, poll_interval_s=0.05)
+    n1 = NodeInfo("127.0.0.1", 9001, 9101)
+    n2 = NodeInfo("127.0.0.1", 9002, 9102)
+    q = d1.subscribe()
+    await d1.register(n1, lambda: True)
+    await d2.register(n2, lambda: True)
+    # wait until both visible
+    seen = []
+    for _ in range(50):
+        try:
+            seen = await asyncio.wait_for(q.get(), 0.5)
+        except asyncio.TimeoutError:
+            break
+        if len(seen) == 2:
+            break
+    assert {n.ident for n in seen} == {n1.ident, n2.ident}
+    await d2.unregister()
+    data = json.load(open(path))
+    assert data["nodes"] == [n1.ident]
+    await d1.unregister()
+
+
+@asynccontextmanager
+async def cache_node(tmp_path, name, store):
+    cache = ModelDiskCache(str(tmp_path / f"cache_{name}"), capacity_bytes=1 << 20)
+    runtime = FakeRuntime()
+    manager = CacheManager(DiskModelProvider(str(store)), cache, runtime)
+    backend = LocalServingBackend(manager)
+    rest = RestServingServer(backend, require_version=False)
+    gsrv = GrpcServingServer(backend)
+    rport = await rest.start(0, host="127.0.0.1")
+    gport = await gsrv.start(0, host="127.0.0.1")
+    try:
+        yield NodeInfo("127.0.0.1", rport, gport), runtime, backend
+    finally:
+        backend.close()
+        await rest.close()
+        await gsrv.close()
+
+
+def make_store(root, models):
+    for name, version in models:
+        d = root / name / str(version)
+        d.mkdir(parents=True)
+        (d / "params.bin").write_bytes(b"x" * 64)
+
+
+async def test_three_node_routed_cluster(tmp_path):
+    store = tmp_path / "store"
+    make_store(store, [(f"tenant{i}", 1) for i in range(30)])
+
+    async with cache_node(tmp_path, "n0", store) as (info0, rt0, backend0):
+        async with cache_node(tmp_path, "n1", store) as (info1, rt1, _):
+            async with cache_node(tmp_path, "n2", store) as (info2, rt2, _):
+                mock = DiscoveryServiceMock()
+                cluster = ClusterConnection(mock, replicas_per_model=1)
+                connect = asyncio.create_task(
+                    cluster.connect(info0, lambda: True, wait_ready_s=2)
+                )
+                await asyncio.sleep(0.05)
+                mock.push([info0, info1, info2])
+                await connect
+                # router colocated with node0: local short-circuit for its keys
+                routing = RoutingBackend(cluster, info0, backend0)
+                router_rest = RestServingServer(routing, require_version=True)
+                router_grpc = GrpcServingServer(routing)
+                rr_port = await router_rest.start(0, host="127.0.0.1")
+                rg_port = await router_grpc.start(0, host="127.0.0.1")
+                try:
+                    # REST through the router for every tenant
+                    async with aiohttp.ClientSession() as s:
+                        for i in range(30):
+                            url = (
+                                f"http://127.0.0.1:{rr_port}/v1/models/tenant{i}"
+                                f"/versions/1:predict"
+                            )
+                            async with s.post(url, json={"instances": [2.0]}) as resp:
+                                assert resp.status == 200, await resp.text()
+                                assert (await resp.json())["predictions"] == [2.0]
+                    # work distributed across the nodes per the ring
+                    per_node = [len(rt.predicts) for rt in (rt0, rt1, rt2)]
+                    assert sum(per_node) == 30
+                    assert all(c > 0 for c in per_node), per_node
+                    # gRPC through the router
+                    ch = make_channel(f"127.0.0.1:{rg_port}")
+                    stub = ServingStub(ch)
+                    req = sv.PredictRequest()
+                    req.model_spec.name = "tenant0"
+                    req.model_spec.version.value = 1
+                    req.inputs["x"].dtype = 1
+                    req.inputs["x"].tensor_shape.dim.add(size=1)
+                    req.inputs["x"].float_val.append(3.0)
+                    resp = await stub.method(PREDICTION_SERVICE, "Predict")(req)
+                    assert codec.tensorproto_to_numpy(resp.outputs["y"]).tolist() == [3.0]
+                    await ch.close()
+
+                    # failover: drop node2 from membership; its keys remap and
+                    # every tenant still serves (emergent recovery, SURVEY §3.4)
+                    mock.push([info0, info1])
+                    await asyncio.sleep(0.05)
+                    async with aiohttp.ClientSession() as s:
+                        for i in range(30):
+                            url = (
+                                f"http://127.0.0.1:{rr_port}/v1/models/tenant{i}"
+                                f"/versions/1:predict"
+                            )
+                            async with s.post(url, json={"instances": [1.0]}) as resp:
+                                assert resp.status == 200
+                    assert len(rt0.predicts) + len(rt1.predicts) >= 60 - len(rt2.predicts)
+                finally:
+                    await routing.close()
+                    await router_rest.close()
+                    await router_grpc.close()
+                    await cluster.disconnect()
+
+
+async def test_router_retries_dead_replica(tmp_path):
+    """First-choice node is down: with replicas=2 the router retries the
+    second replica (the reference has no retries — README.md:72-74 TODO)."""
+    store = tmp_path / "store"
+    make_store(store, [("m", 1)])
+    async with cache_node(tmp_path, "live", store) as (live_info, live_rt, _):
+        dead_info = NodeInfo("127.0.0.1", 1, 1)  # nothing listens there
+        mock = DiscoveryServiceMock()
+        cluster = ClusterConnection(mock, replicas_per_model=2)
+        self_node = NodeInfo("127.0.0.1", 2, 2)  # router not a serving node
+        connect = asyncio.create_task(cluster.connect(self_node, lambda: True, wait_ready_s=2))
+        await asyncio.sleep(0.05)
+        mock.push([live_info, dead_info])
+        await connect
+        routing = RoutingBackend(cluster, self_node, None)
+        try:
+            for _ in range(6):  # random replica start: hit dead one sometimes
+                req = sv.PredictRequest()
+                req.model_spec.name = "m"
+                req.model_spec.version.value = 1
+                req.inputs["x"].dtype = 1
+                req.inputs["x"].tensor_shape.dim.add(size=1)
+                req.inputs["x"].float_val.append(5.0)
+                resp = await routing.predict(req)
+                assert codec.tensorproto_to_numpy(resp.outputs["y"]).tolist() == [5.0]
+            assert len(live_rt.predicts) == 6
+        finally:
+            await routing.close()
+            await cluster.disconnect()

@@ -1,0 +1,81 @@
+"""Hash ring tests, mirroring the reference's scenarios
+(pkg/taskhandler/cluster_test.go:51-227: determinism over 10k lookups,
+1-node degenerate case, remap-and-return stability across 5->200->5 growth)
+plus balance checks the reference lacked."""
+
+from collections import Counter
+
+from tfservingcache_tpu.cluster.hashring import HashRing
+
+
+def ring_with(n: int, prefix: str = "node") -> HashRing:
+    r = HashRing()
+    r.set_members([f"{prefix}{i}:8094:8095" for i in range(n)])
+    return r
+
+
+def test_deterministic_lookups():
+    r = ring_with(6)
+    keys = [f"model{i}##1" for i in range(6)]
+    first = {k: r.get_n(k, 2) for k in keys}
+    for _ in range(10_000 // len(keys)):
+        for k in keys:
+            assert r.get_n(k, 2) == first[k]
+
+
+def test_single_node_gets_everything():
+    r = ring_with(1)
+    for i in range(50):
+        assert r.get_n(f"m{i}##1", 3) == ["node0:8094:8095"]
+
+
+def test_get_n_distinct_and_clamped():
+    r = ring_with(4)
+    nodes = r.get_n("key##1", 3)
+    assert len(nodes) == len(set(nodes)) == 3
+    assert len(r.get_n("key##1", 99)) == 4  # clamped to member count
+    assert len(r.get_n("key##1", 0)) == 1   # max(n,1)
+
+
+def test_remap_and_return_stability():
+    # grow 5 -> 200 -> 5: keys move while grown, then return to the exact
+    # original owners (cluster_test.go's strongest property)
+    r = ring_with(5)
+    keys = [f"tenant{i}##1" for i in range(200)]
+    original = {k: r.get_n(k, 1) for k in keys}
+    r.set_members([f"node{i}:8094:8095" for i in range(200)])
+    grown = {k: r.get_n(k, 1) for k in keys}
+    assert any(grown[k] != original[k] for k in keys)  # most keys remapped
+    r.set_members([f"node{i}:8094:8095" for i in range(5)])
+    assert {k: r.get_n(k, 1) for k in keys} == original
+
+
+def test_minimal_disruption_on_single_node_loss():
+    # consistent hashing's core property: removing one of 10 nodes remaps
+    # only the keys that node owned
+    r = ring_with(10)
+    keys = [f"m{i}##{i % 3}" for i in range(1000)]
+    before = {k: r.get(k) for k in keys}
+    r.set_members([f"node{i}:8094:8095" for i in range(10) if i != 3])
+    moved = 0
+    for k in keys:
+        after = r.get(k)
+        if before[k] == "node3:8094:8095":
+            assert after != "node3:8094:8095"
+        elif after != before[k]:
+            moved += 1
+    assert moved == 0  # only the dead node's keys moved
+
+
+def test_balance():
+    r = ring_with(8)
+    counts = Counter(r.get(f"model{i}##1") for i in range(8000))
+    assert len(counts) == 8
+    # with 160 vnodes the max/min spread stays moderate
+    assert max(counts.values()) / min(counts.values()) < 1.8
+
+
+def test_empty_ring():
+    r = HashRing()
+    assert r.get_n("anything", 2) == []
+    assert r.get("anything") is None

@@ -1,0 +1,83 @@
+"""ClusterConnection: membership -> ring plumbing.
+
+Reference equivalent: pkg/taskhandler/cluster.go:66-130 — a goroutine
+receives node lists on a channel and atomically replaces the consistent
+ring; ``find_nodes_for_key`` returns the replica set for a routing key.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Callable
+
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.cluster.hashring import HashRing
+from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("cluster")
+
+
+class ClusterConnection:
+    def __init__(
+        self,
+        discovery: DiscoveryService,
+        replicas_per_model: int = 1,
+        vnodes: int = 160,
+    ) -> None:
+        self.discovery = discovery
+        self.replicas_per_model = replicas_per_model
+        self.ring = HashRing(vnodes=vnodes)
+        self._nodes_by_ident: dict[str, NodeInfo] = {}
+        self._task: asyncio.Task | None = None
+        self._first_update = asyncio.Event()
+        # called with the fresh node list after each ring swap (e.g. the
+        # router prunes its peer connection pool here)
+        self.on_update: list[Callable[[list[NodeInfo]], None]] = []
+
+    async def connect(
+        self, self_node: NodeInfo, is_healthy: Callable[[], bool], wait_ready_s: float = 5.0
+    ) -> None:
+        queue = self.discovery.subscribe()
+        self._task = asyncio.create_task(self._update_loop(queue))
+        await self.discovery.register(self_node, is_healthy)
+        try:
+            await asyncio.wait_for(self._first_update.wait(), wait_ready_s)
+        except asyncio.TimeoutError:
+            log.warning("no membership update within %.1fs; ring is empty", wait_ready_s)
+
+    async def _update_loop(self, queue: asyncio.Queue) -> None:
+        while True:
+            nodes: list[NodeInfo] = await queue.get()
+            self._nodes_by_ident = {n.ident: n for n in nodes}
+            self.ring.set_members(list(self._nodes_by_ident))
+            self._first_update.set()
+            log.info("cluster updated: %d node(s)", len(nodes))
+            for cb in self.on_update:
+                try:
+                    cb(nodes)
+                except Exception:  # noqa: BLE001
+                    log.exception("cluster on_update callback failed")
+
+    def find_nodes_for_key(self, key: str) -> list[NodeInfo]:
+        """The full replica set for a key (reference FindNodeForKey,
+        cluster.go:116-130)."""
+        idents = self.ring.get_n(key, self.replicas_per_model)
+        return [self._nodes_by_ident[i] for i in idents if i in self._nodes_by_ident]
+
+    def node_for_key(self, key: str) -> NodeInfo | None:
+        """Random pick among the replicas (reference taskhandler.go:90-91
+        spreads load across replicasPerModel copies)."""
+        nodes = self.find_nodes_for_key(key)
+        return random.choice(nodes) if nodes else None
+
+    @property
+    def node_count(self) -> int:
+        return len(self.ring)
+
+    async def disconnect(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        await self.discovery.unregister()

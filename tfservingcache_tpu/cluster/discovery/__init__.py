@@ -1,0 +1,56 @@
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+
+__all__ = ["DiscoveryService", "create_discovery"]
+
+
+def create_discovery(cfg) -> "DiscoveryService":
+    """Factory by config (reference CreateDiscoveryService,
+    cmd/taskhandler/main.go:127-150)."""
+    from tfservingcache_tpu.config import DiscoveryConfig
+
+    assert isinstance(cfg, DiscoveryConfig)
+    t = cfg.type.lower()
+    try:
+        return _create(cfg, t)
+    except ModuleNotFoundError as e:
+        raise ValueError(
+            f"discovery backend {cfg.type!r} is unavailable in this build: {e}"
+        ) from e
+
+
+def _create(cfg, t: str) -> "DiscoveryService":
+    if t == "static":
+        from tfservingcache_tpu.cluster.discovery.static import StaticDiscoveryService
+
+        return StaticDiscoveryService(cfg.nodes)
+    if t == "file":
+        from tfservingcache_tpu.cluster.discovery.filewatch import FileDiscoveryService
+
+        return FileDiscoveryService(cfg.path, poll_interval_s=cfg.poll_interval_s)
+    if t in ("kubernetes", "k8s"):
+        from tfservingcache_tpu.cluster.discovery.kubernetes import K8sDiscoveryService
+
+        return K8sDiscoveryService(
+            service_name=cfg.service_name,
+            namespace=cfg.namespace,
+            field_selector=cfg.field_selector,
+            poll_interval_s=cfg.poll_interval_s,
+        )
+    if t == "consul":
+        from tfservingcache_tpu.cluster.discovery.consul import ConsulDiscoveryService
+
+        return ConsulDiscoveryService(
+            address=cfg.address,
+            service_name=cfg.service_name,
+            ttl_s=cfg.heartbeat_ttl_s,
+            poll_interval_s=cfg.poll_interval_s,
+        )
+    if t == "etcd":
+        from tfservingcache_tpu.cluster.discovery.etcd import EtcdDiscoveryService
+
+        return EtcdDiscoveryService(
+            address=cfg.address,
+            service_name=cfg.service_name,
+            ttl_s=cfg.heartbeat_ttl_s,
+        )
+    raise ValueError(f"unknown discovery type {cfg.type!r}")

@@ -1,0 +1,43 @@
+"""Discovery interface.
+
+Reference equivalent: the 4-method DiscoveryService interface
+(pkg/taskhandler/cluster.go:25-30) whose narrowness is what makes multi-node
+behavior testable in-process (SURVEY.md §4: DiscoveryServiceMock). Async
+variant: backends push full membership snapshots into subscriber queues;
+subscribers (ClusterConnection) replace their ring atomically per snapshot.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from typing import Callable
+
+from tfservingcache_tpu.types import NodeInfo
+
+
+class DiscoveryService(abc.ABC):
+    def __init__(self) -> None:
+        self._subscribers: list[asyncio.Queue[list[NodeInfo]]] = []
+        self._last: list[NodeInfo] | None = None
+
+    def subscribe(self) -> asyncio.Queue[list[NodeInfo]]:
+        q: asyncio.Queue[list[NodeInfo]] = asyncio.Queue()
+        self._subscribers.append(q)
+        if self._last is not None:
+            q.put_nowait(list(self._last))
+        return q
+
+    def _publish(self, nodes: list[NodeInfo]) -> None:
+        self._last = list(nodes)
+        for q in self._subscribers:
+            q.put_nowait(list(nodes))
+
+    @abc.abstractmethod
+    async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        """Announce this node and start watching membership. ``is_healthy``
+        feeds heartbeats on backends with liveness checks (reference
+        consul.go:138-160 / etcd.go:134-148)."""
+
+    @abc.abstractmethod
+    async def unregister(self) -> None: ...

@@ -1,0 +1,87 @@
+"""File-based discovery: a JSON membership file polled for changes.
+
+Registration appends this node's ident to the file (best-effort, atomic
+rename); peers see it on their next poll. Handy for docker-compose-style
+multi-node demos and failure-injection tests — delete a line, watch the
+ring remap (the reference's emergent-recovery path, SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Callable
+
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("discovery.file")
+
+
+class FileDiscoveryService(DiscoveryService):
+    def __init__(self, path: str, poll_interval_s: float = 2.0) -> None:
+        super().__init__()
+        self.path = path
+        self.poll_interval_s = poll_interval_s
+        self._task: asyncio.Task | None = None
+        self._self_ident: str | None = None
+
+    def _read(self) -> list[str]:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            return list(data.get("nodes", []))
+        except FileNotFoundError:
+            return []
+        except (json.JSONDecodeError, AttributeError) as e:
+            log.warning("membership file %s unreadable: %s", self.path, e)
+            return []
+
+    def _write(self, idents: list[str]) -> None:
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"nodes": sorted(set(idents))}, f)
+        os.replace(tmp, self.path)
+
+    async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        self._self_ident = self_node.ident
+        idents = self._read()
+        if self_node.ident not in idents:
+            self._write(idents + [self_node.ident])
+        self._task = asyncio.create_task(self._poll_loop())
+
+    async def _poll_loop(self) -> None:
+        last: list[str] | None = None
+        while True:
+            idents = self._read()
+            # Re-assert our own membership: two nodes registering at once can
+            # clobber each other's unlocked read-modify-write; converge within
+            # one poll instead of staying absent forever.
+            if self._self_ident and self._self_ident not in idents:
+                try:
+                    self._write(idents + [self._self_ident])
+                    idents = self._read()
+                except OSError as e:
+                    log.warning("could not re-register in %s: %s", self.path, e)
+            if idents != last:
+                last = idents
+                nodes = []
+                for ident in idents:
+                    try:
+                        nodes.append(NodeInfo.from_ident(ident))
+                    except ValueError:
+                        log.warning("bad node ident in %s: %r", self.path, ident)
+                self._publish(nodes)
+            await asyncio.sleep(self.poll_interval_s)
+
+    async def unregister(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._self_ident:
+            idents = [i for i in self._read() if i != self._self_ident]
+            try:
+                self._write(idents)
+            except OSError as e:
+                log.warning("could not deregister from %s: %s", self.path, e)

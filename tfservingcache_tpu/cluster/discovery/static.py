@@ -1,0 +1,25 @@
+"""Static discovery: fixed node list from config — the zero-dependency
+backend for fixed-size TPU pod slices where membership is known up front
+(no reference equivalent; its smallest backend is Consul)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.types import NodeInfo
+
+
+class StaticDiscoveryService(DiscoveryService):
+    def __init__(self, nodes: list[str]) -> None:
+        super().__init__()
+        self.nodes = [NodeInfo.from_ident(n) for n in nodes]
+
+    async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        nodes = list(self.nodes)
+        if all(n.ident != self_node.ident for n in nodes):
+            nodes.append(self_node)
+        self._publish(nodes)
+
+    async def unregister(self) -> None:
+        pass

@@ -1,0 +1,284 @@
+"""Router (task-handler layer): the front door that sends each
+(model, version) to its hash-assigned cache node(s).
+
+Reference equivalent: pkg/taskhandler/taskhandler.go — REST director
+rewrites the URL to the peer's cache REST port (95-114), gRPC director keeps
+a mutex-guarded per-peer channel pool (28-31, 117-147), replica picked at
+random per request (90-91). Differences by design:
+
+  - requests whose hash lands on *this* node short-circuit to the local
+    backend in-process instead of re-entering through localhost;
+  - simple retry-on-next-replica for connection errors (the reference lists
+    retries as a TODO, README.md:72-74).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import aiohttp
+import grpc
+
+from tfservingcache_tpu.cluster.cluster import ClusterConnection
+from tfservingcache_tpu.cluster.discovery import create_discovery
+from tfservingcache_tpu.config import Config
+from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
+from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
+from tfservingcache_tpu.protocol.grpc_server import (
+    MODEL_SERVICE,
+    PREDICTION_SERVICE,
+    SESSION_SERVICE,
+    GrpcServingServer,
+)
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+from tfservingcache_tpu.types import ModelId, NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.net import outbound_ip
+
+log = get_logger("router")
+
+
+class PeerPool:
+    """Per-peer gRPC channel cache (reference grpcConnMap,
+    taskhandler.go:28-31,117-147)."""
+
+    def __init__(self, max_message_bytes: int) -> None:
+        self._stubs: dict[str, ServingStub] = {}
+        self._lock = asyncio.Lock()
+        self._max_message_bytes = max_message_bytes
+
+    async def stub(self, node: NodeInfo) -> ServingStub:
+        key = f"{node.host}:{node.grpc_port}"
+        if key in self._stubs:
+            return self._stubs[key]
+        async with self._lock:
+            if key not in self._stubs:
+                self._stubs[key] = ServingStub(make_channel(key, self._max_message_bytes))
+            return self._stubs[key]
+
+    def prune(self, live: list[NodeInfo]) -> None:
+        """Close channels to peers no longer in the cluster (without this a
+        long-lived router leaks a channel per node ever seen)."""
+        keep = {f"{n.host}:{n.grpc_port}" for n in live}
+        for key in [k for k in self._stubs if k not in keep]:
+            stub = self._stubs.pop(key)
+            asyncio.ensure_future(stub.channel.close())
+
+    async def close(self) -> None:
+        for stub in self._stubs.values():
+            await stub.channel.close()
+        self._stubs.clear()
+
+
+class RoutingBackend(ServingBackend):
+    """ServingBackend that forwards to hash-owned peers (or serves locally
+    when this node owns the key)."""
+
+    def __init__(
+        self,
+        cluster: ClusterConnection,
+        self_node: NodeInfo,
+        local_backend: ServingBackend | None,
+        max_message_bytes: int = 16 << 20,
+        retries: int = 2,
+    ) -> None:
+        self.cluster = cluster
+        self.self_node = self_node
+        self.local_backend = local_backend
+        self.pool = PeerPool(max_message_bytes)
+        self.retries = retries
+        self._http: aiohttp.ClientSession | None = None
+        cluster.on_update.append(self.pool.prune)
+
+    def _http_session(self) -> aiohttp.ClientSession:
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession()
+        return self._http
+
+    # -- routing core -------------------------------------------------------
+    def _candidates(self, name: str, version: int | str | None) -> list[NodeInfo]:
+        """Replica set in random-start order (random pick + failover list)."""
+        key = ModelId(name, int(version or 0)).key
+        nodes = self.cluster.find_nodes_for_key(key)
+        if not nodes:
+            raise BackendError(
+                "no serving nodes in cluster", grpc.StatusCode.UNAVAILABLE, 503
+            )
+        start = random.randrange(len(nodes))
+        return nodes[start:] + nodes[:start]
+
+    def _is_self(self, node: NodeInfo) -> bool:
+        return node.ident == self.self_node.ident
+
+    async def _forward_grpc(self, service: str, method: str, name: str, version, request):
+        last_err: Exception | None = None
+        for attempt, node in enumerate(self._candidates(name, version)[: self.retries + 1]):
+            if self._is_self(node) and self.local_backend is not None:
+                fn = {
+                    (PREDICTION_SERVICE, "Predict"): self.local_backend.predict,
+                    (PREDICTION_SERVICE, "Classify"): self.local_backend.classify,
+                    (PREDICTION_SERVICE, "Regress"): self.local_backend.regress,
+                    (PREDICTION_SERVICE, "GetModelMetadata"): self.local_backend.get_model_metadata,
+                    (MODEL_SERVICE, "GetModelStatus"): self.local_backend.get_model_status,
+                    (SESSION_SERVICE, "SessionRun"): self.local_backend.session_run,
+                }[(service, method)]
+                return await fn(request)
+            try:
+                stub = await self.pool.stub(node)
+                return await stub.method(service, method)(request)
+            except grpc.aio.AioRpcError as e:
+                if e.code() in (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED):
+                    # connection-level failure: try the next replica
+                    last_err = e
+                    log.warning(
+                        "peer %s unavailable for %s/%s (attempt %d): %s",
+                        node.ident, service, method, attempt + 1, e.details(),
+                    )
+                    continue
+                raise
+        assert last_err is not None
+        raise last_err
+
+    # -- ServingBackend (gRPC shapes) ---------------------------------------
+    async def predict(self, request: sv.PredictRequest) -> sv.PredictResponse:
+        spec = request.model_spec
+        return await self._forward_grpc(
+            PREDICTION_SERVICE, "Predict", spec.name, spec.version.value, request
+        )
+
+    async def classify(self, request: sv.ClassificationRequest) -> sv.ClassificationResponse:
+        spec = request.model_spec
+        return await self._forward_grpc(
+            PREDICTION_SERVICE, "Classify", spec.name, spec.version.value, request
+        )
+
+    async def regress(self, request: sv.RegressionRequest) -> sv.RegressionResponse:
+        spec = request.model_spec
+        return await self._forward_grpc(
+            PREDICTION_SERVICE, "Regress", spec.name, spec.version.value, request
+        )
+
+    async def get_model_metadata(self, request):
+        spec = request.model_spec
+        return await self._forward_grpc(
+            PREDICTION_SERVICE, "GetModelMetadata", spec.name, spec.version.value, request
+        )
+
+    async def session_run(self, request: sv.SessionRunRequest) -> sv.SessionRunResponse:
+        spec = request.model_spec
+        return await self._forward_grpc(
+            SESSION_SERVICE, "SessionRun", spec.name, spec.version.value, request
+        )
+
+    async def get_model_status(self, request: sv.GetModelStatusRequest):
+        spec = request.model_spec
+        return await self._forward_grpc(
+            MODEL_SERVICE, "GetModelStatus", spec.name, spec.version.value, request
+        )
+
+    async def reload_config(self, request: sv.ReloadConfigRequest) -> sv.ReloadConfigResponse:
+        # parity: the reference proxy does not expose ModelService reloads
+        raise BackendError(
+            "reload_config is served by cache nodes, not the router",
+            grpc.StatusCode.UNIMPLEMENTED,
+            501,
+        )
+
+    # -- REST forwarding ----------------------------------------------------
+    async def handle_rest(
+        self,
+        method: str,
+        model_name: str,
+        version: int | None,
+        verb: str | None,
+        body: bytes,
+    ) -> RestResponse:
+        last_err: Exception | None = None
+        for node in self._candidates(model_name, version)[: self.retries + 1]:
+            if self._is_self(node) and self.local_backend is not None:
+                return await self.local_backend.handle_rest(
+                    method, model_name, version, verb, body
+                )
+            url = f"http://{node.host}:{node.rest_port}/v1/models/{model_name}"
+            if version is not None:
+                url += f"/versions/{version}"
+            if verb == "metadata":
+                url += "/metadata"
+            elif verb is not None:
+                url += f":{verb}"
+            try:
+                async with self._http_session().request(
+                    method, url, data=body or None
+                ) as resp:
+                    payload = await resp.read()
+                    return RestResponse(
+                        status=resp.status,
+                        body=payload,
+                        content_type=resp.content_type or "application/json",
+                    )
+            except aiohttp.ClientConnectionError as e:
+                last_err = e
+                log.warning("peer %s unreachable for REST %s: %s", node.ident, url, e)
+                continue
+        raise BackendError(
+            f"all replicas unreachable: {last_err}", grpc.StatusCode.UNAVAILABLE, 503
+        )
+
+    async def close(self) -> None:
+        await self.pool.close()
+        if self._http is not None and not self._http.closed:
+            await self._http.close()
+
+
+class Router:
+    """The proxy service pair (REST + gRPC) bound to the proxy ports,
+    connected to discovery (reference serveProxy, main.go:66-113)."""
+
+    def __init__(self, cfg: Config, node) -> None:
+        self.cfg = cfg
+        self.node = node  # CacheNode (for local short-circuit + health)
+        self.discovery = create_discovery(cfg.discovery)
+        self.cluster = ClusterConnection(self.discovery, cfg.proxy.replicas_per_model)
+        host = "127.0.0.1" if cfg.discovery.prefer_localhost else outbound_ip()
+        self.self_node = NodeInfo(host, cfg.cache_node.rest_port, cfg.cache_node.grpc_port)
+        self.backend = RoutingBackend(
+            self.cluster,
+            self.self_node,
+            node.backend if node is not None else None,
+            cfg.proxy.grpc_max_message_bytes,
+        )
+        metrics = node.metrics if node is not None else None
+        self.rest = RestServingServer(
+            self.backend, metrics, require_version=True, metrics_path=cfg.metrics.path
+        )
+        self.grpc = GrpcServingServer(self.backend, metrics, cfg.proxy.grpc_max_message_bytes)
+        self._health_task: asyncio.Task | None = None
+
+    async def start(self) -> tuple[int, int]:
+        await self.cluster.connect(
+            self.self_node,
+            (self.node.is_healthy if self.node is not None else lambda: True),
+        )
+        rest_port = await self.rest.start(self.cfg.proxy.rest_port)
+        grpc_port = await self.grpc.start(self.cfg.proxy.grpc_port)
+        self._health_task = asyncio.create_task(self._health_loop())
+        log.info(
+            "router up: REST :%d gRPC :%d as %s (%d nodes)",
+            rest_port, grpc_port, self.self_node.ident, self.cluster.node_count,
+        )
+        return rest_port, grpc_port
+
+    async def _health_loop(self) -> None:
+        while True:
+            self.grpc.set_health(self.cluster.node_count > 0)
+            await asyncio.sleep(30)
+
+    async def close(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+        await self.cluster.disconnect()
+        await self.backend.close()
+        await self.rest.close()
+        await self.grpc.close()

@@ -1,0 +1,17 @@
+"""Network helpers."""
+
+from __future__ import annotations
+
+import socket
+
+
+def outbound_ip(probe_addr: tuple[str, int] = ("8.8.8.8", 80)) -> str:
+    """Best-effort outbound interface IP via the UDP-connect trick, falling
+    back to localhost (reference etcd.go:152-166)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(0.5)
+            s.connect(probe_addr)
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
