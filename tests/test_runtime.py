@@ -112,3 +112,22 @@ def test_signature(runtime, tmp_path):
     inputs, outputs, method = runtime.signature(model.identifier)
     assert inputs["x"].dtype == "float32" and method == "tensorflow/serving/predict"
     assert "y" in outputs
+
+
+def test_executable_shared_across_tenants_and_freed(tmp_path):
+    from tfservingcache_tpu.models.registry import build
+
+    rt = TPUModelRuntime(ServingConfig(hbm_capacity_bytes=1 << 20))
+    try:
+        m1 = make_model(tmp_path, name="shareA", version=1)
+        m2 = make_model(tmp_path, name="shareB", version=1)
+        rt.ensure_loaded(m1)
+        rt.ensure_loaded(m2)
+        key = build("half_plus_two").cache_key
+        assert rt._jitted_by_key[key][1] == 2       # both tenants share one entry
+        rt.unload(m1.identifier)
+        assert rt._jitted_by_key[key][1] == 1
+        rt.unload(m2.identifier)
+        assert key not in rt._jitted_by_key         # last tenant freed the executable
+    finally:
+        rt.close()

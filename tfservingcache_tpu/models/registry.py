@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
@@ -57,6 +58,9 @@ class ModelDef:
     input_spec: dict[str, TensorSpec]
     output_spec: dict[str, TensorSpec]
     method_name: str = "tensorflow/serving/predict"
+    # canonical (family, config) identity assigned by build(); the runtime
+    # keys shared executables by this
+    cache_key: str = ""
     # mesh-axis partition rules for multi-chip serving, e.g.
     # {("dense", "kernel"): (None, "model")}; consumed by parallel.sharding
     partition_rules: dict[str, Any] = field(default_factory=dict)
@@ -82,13 +86,33 @@ def families() -> list[str]:
     return sorted(_REGISTRY)
 
 
+_BUILD_CACHE: dict[str, ModelDef] = {}
+_BUILD_LOCK = threading.Lock()
+
+
 def build(family: str, config: dict[str, Any] | None = None) -> ModelDef:
+    """Build (memoized) a family instance.
+
+    Memoization is load-bearing for multi-tenant serving performance: every
+    tenant artifact of the same (family, config) shares ONE ModelDef, hence
+    one ``apply`` function identity, hence one jit cache entry and one XLA
+    executable — tenant N's cold load skips compilation entirely and costs
+    only the params fetch + device_put. The reference cannot do this: TF
+    Serving compiles/loads each SavedModel independently.
+    """
     _load_builtin_families()
     if family not in _REGISTRY:
         raise KeyError(f"unknown model family {family!r}; known: {families()}")
     merged = dict(_DEFAULT_CONFIGS[family])
     merged.update(config or {})
-    return _REGISTRY[family](merged)
+    key = f"{family}|{json.dumps(merged, sort_keys=True, default=str)}"
+    with _BUILD_LOCK:  # one ModelDef identity per key, even under racing loads
+        model = _BUILD_CACHE.get(key)
+        if model is None:
+            model = _REGISTRY[family](merged)
+            model.cache_key = key
+            _BUILD_CACHE[key] = model
+    return model
 
 
 _BUILTIN_MODULES = ("half_plus_two", "mnist_cnn", "bert", "resnet", "transformer_lm")

@@ -86,6 +86,13 @@ class TPUModelRuntime(BaseRuntime):
         )
         self._load_locks: dict[ModelId, threading.Lock] = {}
         self._load_locks_guard = threading.Lock()
+        # One jitted apply per (family, config) build key: all tenants of a
+        # family share one XLA executable — tenant N's cold load is
+        # params-transfer only. Entries are refcounted by resident models and
+        # dropped when the last tenant is evicted, so executables don't pin
+        # device memory after every user of them is gone.
+        self._jitted_by_key: dict[str, tuple[Any, int]] = {}
+        self._jit_lock = threading.Lock()
 
     # -- load ---------------------------------------------------------------
     def ensure_loaded(self, model: Model) -> None:
@@ -109,7 +116,14 @@ class TPUModelRuntime(BaseRuntime):
             self._set_state(mid, ModelState.LOADING)
             model_def, host_params = load_artifact(model.path)
             params = jax.device_put(host_params, self._devices[0])
-            jitted = jax.jit(model_def.apply)
+            with self._jit_lock:
+                entry = self._jitted_by_key.get(model_def.cache_key)
+                if entry is None:
+                    jitted = jax.jit(model_def.apply)
+                    self._jitted_by_key[model_def.cache_key] = (jitted, 1)
+                else:
+                    jitted = entry[0]
+                    self._jitted_by_key[model_def.cache_key] = (jitted, entry[1] + 1)
             hbm = tree_nbytes(params)
             loaded = LoadedModel(model_def, params, jitted, hbm)
             if self.cfg.warmup:
@@ -225,6 +239,15 @@ class TPUModelRuntime(BaseRuntime):
         # LoadedModel keep the device arrays alive until they finish, then XLA
         # frees the HBM when the last reference goes. (Nulling the fields here
         # would crash those in-flight calls.)
+        key = entry.payload.model_def.cache_key
+        with self._jit_lock:
+            shared = self._jitted_by_key.get(key)
+            if shared is not None:
+                jitted, refs = shared
+                if refs <= 1:
+                    del self._jitted_by_key[key]  # last tenant gone: free the executable
+                else:
+                    self._jitted_by_key[key] = (jitted, refs - 1)
         self._set_state(model_id, ModelState.END)
         if self.metrics is not None:
             self.metrics.evictions.labels("hbm").inc()
