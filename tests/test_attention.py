@@ -1,0 +1,43 @@
+"""Flash-attention kernel vs jnp reference, in Pallas interpret mode on CPU
+(the same kernel compiles natively on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.ops.attention import attention_reference, flash_attention
+
+
+def rand_qkv(b, h, s, d, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("s", [128, 256])
+def test_flash_matches_reference(causal, s):
+    q, k, v = rand_qkv(2, 3, s, 64)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_padded_seq():
+    # S=160 pads to 256 internally; padded keys must not leak into softmax
+    q, k, v = rand_qkv(1, 2, 160, 64, seed=1)
+    for causal in (True, False):
+        ref = attention_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16():
+    q, k, v = rand_qkv(1, 2, 128, 64, dtype=jnp.bfloat16, seed=2)
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2, rtol=2e-2
+    )

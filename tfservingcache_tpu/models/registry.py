@@ -174,7 +174,18 @@ def load_artifact(path: str) -> tuple[ModelDef, Any]:
     with open(os.path.join(path, PARAMS_FILE), "rb") as f:
         # msgpack_restore avoids needing an init()-built template at load time
         params = serialization.msgpack_restore(f.read())
-    return model, params
+    return model, _restore_lists(params)
+
+
+def _restore_lists(tree: Any) -> Any:
+    """flax msgpack round-trips Python lists as {"0": ..., "1": ...} dicts;
+    convert them back so families can keep natural list-of-layers params."""
+    if isinstance(tree, dict):
+        restored = {k: _restore_lists(v) for k, v in tree.items()}
+        if restored and all(k.isdigit() for k in restored):
+            return [restored[k] for k in sorted(restored, key=int)]
+        return restored
+    return tree
 
 
 def export_artifact(

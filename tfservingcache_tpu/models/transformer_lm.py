@@ -1,0 +1,190 @@
+"""transformer_lm — the flagship decoder-LM family (Llama/T5-XL-class,
+BASELINE.json config #5: models that span >1 TPU chip, served by chip
+groups the ring assigns).
+
+TPU-first design:
+  - bf16 matmuls (MXU), fp32 softmax/norm accumulation;
+  - Pallas flash attention on TPU (ops/attention.py), jnp fallback on CPU;
+  - pure-functional params pytree with explicit tensor-parallel partition
+    rules (megatron-style: attention/MLP sharded over the "model" mesh axis,
+    collectives inserted by XLA from the shardings — no hand-written NCCL,
+    SURVEY.md §2 distributed-backend inventory);
+  - weights stored f32 in the artifact, cast to bf16 at apply time.
+
+Config presets cover smoke tests through llama-7b-class shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, register
+from tfservingcache_tpu.ops.attention import attention
+
+DEFAULT_CONFIG: dict[str, Any] = {
+    "vocab_size": 2048,
+    "d_model": 256,
+    "n_layers": 4,
+    "n_heads": 8,
+    "n_kv_heads": 4,       # GQA
+    "d_ff": 1024,
+    "max_seq": 1024,
+    "rope_theta": 10000.0,
+    "dtype": "bfloat16",
+}
+
+# llama-2-7b-class shape for multi-chip serving/benching
+LLAMA7B_CONFIG: dict[str, Any] = {
+    "vocab_size": 32000,
+    "d_model": 4096,
+    "n_layers": 32,
+    "n_heads": 32,
+    "n_kv_heads": 32,
+    "d_ff": 11008,
+    "max_seq": 4096,
+    "rope_theta": 10000.0,
+    "dtype": "bfloat16",
+}
+
+
+def _rmsnorm(x: jax.Array, gain: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * gain.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over (B, H, S, D)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)      # (d/2,)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]     # (S, d/2)
+    cos = jnp.cos(angles)[None, None]                                    # (1,1,S,d/2)
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.reshape(x.shape).astype(x.dtype)
+
+
+def _attention_block(params: dict, x: jax.Array, cfg: dict) -> jax.Array:
+    b, s, d_model = x.shape
+    n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
+    head_dim = d_model // n_heads
+    q = (x @ params["wq"]).reshape(b, s, n_heads, head_dim).transpose(0, 2, 1, 3)
+    k = (x @ params["wk"]).reshape(b, s, n_kv, head_dim).transpose(0, 2, 1, 3)
+    v = (x @ params["wv"]).reshape(b, s, n_kv, head_dim).transpose(0, 2, 1, 3)
+    positions = jnp.arange(s)
+    q = _rope(q, positions, cfg["rope_theta"])
+    k = _rope(k, positions, cfg["rope_theta"])
+    if n_kv != n_heads:  # GQA: repeat KV groups up to query heads
+        k = jnp.repeat(k, n_heads // n_kv, axis=1)
+        v = jnp.repeat(v, n_heads // n_kv, axis=1)
+    out = attention(q, k, v, causal=True)                               # (b,h,s,hd)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, d_model)
+    return out @ params["wo"]
+
+
+def _mlp_block(params: dict, x: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ params["w1"])
+    up = x @ params["w3"]
+    return (gate * up) @ params["w2"]
+
+
+def _forward(params: dict, input_ids: jax.Array, cfg: dict) -> jax.Array:
+    dtype = jnp.dtype(cfg["dtype"])
+    x = params["embed"][input_ids].astype(dtype)                        # (b,s,d)
+    for layer in params["layers"]:
+        x = x + _attention_block(
+            jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["attn"]),
+            _rmsnorm(x, layer["ln1"]),
+            cfg,
+        )
+        x = x + _mlp_block(
+            jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["mlp"]),
+            _rmsnorm(x, layer["ln2"]),
+        )
+    x = _rmsnorm(x, params["ln_f"])
+    # logits in f32 for a stable softmax/argmax downstream
+    return (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+
+
+@register("transformer_lm", DEFAULT_CONFIG)
+def build(config: dict) -> ModelDef:
+    cfg = config
+
+    def apply(params, inputs):
+        # logits only: the runtime pads the sequence axis to shape buckets,
+        # and causal masking keeps valid positions exact — but any "last
+        # token" reduction would land on padding, so sampling stays client-
+        # side (or in the generate helper, which tracks true lengths).
+        logits = _forward(params, inputs["input_ids"].astype(jnp.int32), cfg)
+        return {"logits": logits}
+
+    def init(rng):
+        d, v, ff = cfg["d_model"], cfg["vocab_size"], cfg["d_ff"]
+        n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
+        head_dim = d // n_heads
+        keys = jax.random.split(rng, cfg["n_layers"] + 1)
+
+        def dense(key, fan_in, shape):
+            return (jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in))
+
+        layers = []
+        for i in range(cfg["n_layers"]):
+            ks = jax.random.split(keys[i], 7)
+            layers.append(
+                {
+                    "attn": {
+                        "wq": dense(ks[0], d, (d, n_heads * head_dim)),
+                        "wk": dense(ks[1], d, (d, n_kv * head_dim)),
+                        "wv": dense(ks[2], d, (d, n_kv * head_dim)),
+                        "wo": dense(ks[3], n_heads * head_dim, (n_heads * head_dim, d)),
+                    },
+                    "mlp": {
+                        "w1": dense(ks[4], d, (d, ff)),
+                        "w2": dense(ks[5], ff, (ff, d)),
+                        "w3": dense(ks[6], d, (d, ff)),
+                    },
+                    "ln1": jnp.ones((d,), jnp.float32),
+                    "ln2": jnp.ones((d,), jnp.float32),
+                }
+            )
+        return {
+            "embed": dense(keys[-1], d, (v, d)),
+            "layers": layers,
+            "ln_f": jnp.ones((d,), jnp.float32),
+        }
+
+    def loss(params, inputs, targets):
+        logits = _forward(params, inputs["input_ids"].astype(jnp.int32), cfg)
+        labels = targets["labels"].astype(jnp.int32)
+        # next-token cross entropy, ignoring the final position
+        logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+        tgt = labels[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    # Megatron-style tensor parallelism over the "model" mesh axis: column-
+    # parallel QKV/W1/W3, row-parallel WO/W2 (XLA inserts the all-reduces).
+    partition_rules = {
+        "embed": (None, "model"),
+        r"layers/\d+/attn/w[qkv]": (None, "model"),
+        r"layers/\d+/attn/wo": ("model", None),
+        r"layers/\d+/mlp/w[13]": (None, "model"),
+        r"layers/\d+/mlp/w2": ("model", None),
+        r".*ln.*": (None,),
+    }
+
+    return ModelDef(
+        family="transformer_lm",
+        config=cfg,
+        apply=apply,
+        init=init,
+        input_spec={"input_ids": TensorSpec("int32", (-1, -1))},
+        output_spec={"logits": TensorSpec("float32", (-1, -1, cfg["vocab_size"]))},
+        partition_rules=partition_rules,
+        loss=loss,
+    )

@@ -1,0 +1,165 @@
+"""Attention ops: a Pallas TPU flash-attention kernel + jnp reference.
+
+No reference-counterpart exists (the reference proxies opaque tensors and
+never computes; SURVEY.md §5) — this is the TPU-native compute core for the
+transformer families. Design per /opt/skills/guides/pallas_guide.md:
+
+  - online-softmax over K/V blocks so the (S x S) score matrix never
+    materializes in HBM (memory O(block_q x block_k) in VMEM);
+  - block sizes aligned to the MXU/VPU tiling (multiples of 128 lanes);
+  - fp32 accumulation regardless of input dtype (bf16 in, f32 softmax);
+  - causal masking skips fully-masked K blocks via the loop bound itself.
+
+The public entry ``attention`` dispatches: Pallas kernel on TPU backends,
+jnp reference elsewhere (tests compare the two in interpret mode).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# jnp reference implementation
+# ---------------------------------------------------------------------------
+
+def attention_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """(B, H, S, D) attention, fp32 softmax, output in q.dtype."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s / math.sqrt(d)
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool, block_q: int,
+    block_k: int, valid_len: int,
+):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale            # (bq, d)
+    qi = pl.program_id(1)
+    seq_len = k_ref.shape[1]
+    q_offset = qi * block_q
+
+    if causal:
+        # only K blocks at or before this Q block's last row participate
+        num_k_blocks = jnp.minimum(
+            (q_offset + block_q + block_k - 1) // block_k, seq_len // block_k
+        )
+    else:
+        num_k_blocks = seq_len // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)   # (bk, d)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )                                                                   # (bq, bk)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = k_pos < valid_len  # padded K rows never participate
+        if causal:
+            q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))          # (bq, 1)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc * alpha + pv, m_new, l_new
+
+    acc = jnp.zeros((q.shape[0], q_ref.shape[2]), jnp.float32)
+    m = jnp.full((q.shape[0], 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over (B, H, S, D). S is padded to a block multiple
+    internally; GQA callers repeat K/V heads before the call."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, max(s, 16))
+    block_k = min(block_k, max(s, 16))
+    pad = (-s) % max(block_q, block_k)
+    if pad:
+        zeros = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        q, k, v = zeros(q), zeros(k), zeros(v)
+    sp = q.shape[2]
+    qf = q.reshape(b * h, sp, d)
+    kf = k.reshape(b * h, sp, d)
+    vf = v.reshape(b * h, sp, d)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, valid_len=s,
+    )
+    grid = (b * h, sp // block_q)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sp, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sp, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+    )(qf, kf, vf)
+    out = out.reshape(b, h, sp, d)
+    if pad:
+        out = out[:, :, :s, :]
+    return out
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
+    """Dispatch: Pallas flash kernel on TPU, jnp reference elsewhere (the
+    kernel's interpret mode is for tests, too slow for CPU serving)."""
+    if (
+        jax.default_backend() == "tpu"
+        and q.shape[-1] % 128 == 0
+        and q.shape[2] >= 128
+        and k.shape[2] == q.shape[2]  # kernel assumes self-attention lengths
+    ):
+        return flash_attention(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal)

@@ -1,0 +1,93 @@
+"""Ring attention: context parallelism for sequences too long for one chip.
+
+First-class by design mandate (no reference counterpart — the reference
+never touches tensors). Q/K/V are sharded along the sequence axis across a
+mesh axis; each step computes attention of the local Q block against the
+currently-held K/V block, then rotates K/V one hop around the ring with
+``ppermute`` (ICI neighbor exchange), accumulating an online softmax exactly
+like flash attention does across its K blocks. After P steps every Q block
+has seen every K/V block while per-chip memory stays O(S/P).
+
+Communication pattern: P-1 ppermute rounds of the K/V shards — bandwidth
+equals one all-gather of K/V but overlapped with compute and never
+materializing the full sequence on any chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_off, k_off, causal, acc, m, l):
+    """One online-softmax update of (acc, m, l) with a K/V block at global
+    offset ``k_off`` against Q at global offset ``q_off``. All fp32."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return acc_new, m_new, l_new
+
+
+def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool):
+    """Per-shard body under shard_map: local (B, H, S/P, D) blocks."""
+    idx = jax.lax.axis_index(axis)
+    s_local = q.shape[2]
+    qf = q.astype(jnp.float32)
+    acc = jnp.zeros(qf.shape, jnp.float32)
+    m = jnp.full(qf.shape[:3] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros(qf.shape[:3] + (1,), jnp.float32)
+    q_off = idx * s_local
+
+    k_cur, v_cur = k.astype(jnp.float32), v.astype(jnp.float32)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+    for step in range(n_shards):
+        # after `step` rotations, this chip holds the block that started at
+        # ring position (idx - step) mod P
+        src = (idx - step) % n_shards
+        k_off = src * s_local
+        acc, m, l = _block_attend(qf, k_cur, v_cur, q_off, k_off, causal, acc, m, l)
+        if step + 1 < n_shards:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    """(B, H, S, D) attention with S sharded over ``mesh[axis]``. The full
+    sequence never resides on one chip."""
+    from jax.experimental.shard_map import shard_map
+
+    n_shards = mesh.shape[axis]
+    if q.shape[2] % n_shards:
+        raise ValueError(f"sequence {q.shape[2]} not divisible by {n_shards} ring shards")
+    spec = P(None, None, axis, None)
+    fn = shard_map(
+        functools.partial(_ring_shard_fn, axis=axis, n_shards=n_shards, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

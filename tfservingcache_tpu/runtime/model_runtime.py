@@ -115,20 +115,41 @@ class TPUModelRuntime(BaseRuntime):
         try:
             self._set_state(mid, ModelState.LOADING)
             model_def, host_params = load_artifact(model.path)
-            params = jax.device_put(host_params, self._devices[0])
+            if self.mesh is not None and model_def.partition_rules:
+                # multi-chip model: params sharded over the chip group per the
+                # family's partition rules; XLA partitions the computation and
+                # inserts ICI collectives from the committed shardings
+                from tfservingcache_tpu.parallel.sharding import shard_params
+
+                params = shard_params(host_params, model_def.partition_rules, self.mesh)
+            else:
+                params = jax.device_put(host_params, self._devices[0])
+            key = model_def.cache_key
             with self._jit_lock:
-                entry = self._jitted_by_key.get(model_def.cache_key)
-                if entry is None:
+                entry = self._jitted_by_key.get(key)
+                created = entry is None
+                if created:
                     jitted = jax.jit(model_def.apply)
-                    self._jitted_by_key[model_def.cache_key] = (jitted, 1)
+                    # refcount 0 until this model is actually resident; the
+                    # failure path below removes a 0-ref entry it created
+                    self._jitted_by_key[key] = (jitted, 0)
                 else:
                     jitted = entry[0]
-                    self._jitted_by_key[model_def.cache_key] = (jitted, entry[1] + 1)
-            hbm = tree_nbytes(params)
-            loaded = LoadedModel(model_def, params, jitted, hbm)
-            if self.cfg.warmup:
-                self._warmup(loaded)
-            self._resident.put(mid, hbm, loaded)
+            try:
+                hbm = tree_nbytes(params)
+                loaded = LoadedModel(model_def, params, jitted, hbm)
+                if self.cfg.warmup:
+                    self._warmup(loaded)
+                self._resident.put(mid, hbm, loaded)
+            except Exception:
+                with self._jit_lock:
+                    cur = self._jitted_by_key.get(key)
+                    if created and cur is not None and cur[1] == 0:
+                        del self._jitted_by_key[key]  # don't pin an executable no one uses
+                raise
+            with self._jit_lock:
+                jfn, refs = self._jitted_by_key.get(key, (jitted, 0))
+                self._jitted_by_key[key] = (jfn, refs + 1)
             self._set_state(mid, ModelState.AVAILABLE)
         except Exception as e:
             self._set_state(mid, ModelState.END)
@@ -177,7 +198,7 @@ class TPUModelRuntime(BaseRuntime):
         if unknown:
             raise RuntimeError_(f"unknown inputs {sorted(unknown)} for {model_id}")
 
-        batch, padded = self._pad_to_bucket(spec, inputs)
+        dyn_sizes, padded = self._pad_to_bucket(spec, inputs)
         out = loaded.jitted(loaded.params, padded)
         out = jax.device_get(out)
         out_spec = loaded.model_def.output_spec
@@ -186,14 +207,20 @@ class TPUModelRuntime(BaseRuntime):
             if output_filter and name not in output_filter:
                 continue
             arr = np.asarray(arr)
-            if batch is not None:
-                # un-pad only along the axis the output spec marks as batch —
-                # fixed-shape outputs (e.g. a vocab vector) pass through whole
-                ospec = out_spec.get(name)
-                if ospec is not None and -1 in ospec.shape:
-                    axis = ospec.shape.index(-1)
-                    if arr.ndim > axis and arr.shape[axis] >= batch:
-                        arr = np.take(arr, range(batch), axis=axis)
+            # un-pad along every axis the output spec marks dynamic: the i-th
+            # -1 of each spec maps to the i-th shared dynamic size (batch,
+            # then seq, ...); fixed-shape outputs pass through whole
+            ospec = out_spec.get(name)
+            if ospec is not None and dyn_sizes:
+                slot = 0
+                for axis, d in enumerate(ospec.shape):
+                    if d != -1:
+                        continue
+                    if slot < len(dyn_sizes) and arr.ndim > axis:
+                        true = dyn_sizes[slot]
+                        if arr.shape[axis] > true:
+                            arr = np.take(arr, range(true), axis=axis)
+                    slot += 1
             result[name] = arr
         if output_filter and not result:
             raise RuntimeError_(
@@ -203,34 +230,52 @@ class TPUModelRuntime(BaseRuntime):
 
     def _pad_to_bucket(
         self, spec: Mapping[str, TensorSpec], inputs: Mapping[str, np.ndarray]
-    ) -> tuple[int | None, dict[str, np.ndarray]]:
-        """-> (true batch or None if family is unbatched, padded inputs)."""
-        batch: int | None = None
+    ) -> tuple[list[int], dict[str, np.ndarray]]:
+        """-> (true dynamic sizes, padded inputs).
+
+        Every -1 axis is padded up to a power-of-two bucket. The i-th dynamic
+        axis of each input maps to shared slot i (slot 0 = batch, slot 1 =
+        sequence for LMs) and the sizes must agree across inputs.
+        """
+        dyn_sizes: list[int] = []
         for name, s in spec.items():
-            if -1 in s.shape:
-                arr = np.asarray(inputs[name])
-                axis = s.shape.index(-1)
+            arr = np.asarray(inputs[name])
+            slot = 0
+            for axis, d in enumerate(s.shape):
+                if d != -1:
+                    continue
                 if arr.ndim <= axis:
                     raise RuntimeError_(
                         f"input {name!r} needs at least {axis + 1} dims, got shape {arr.shape}"
                     )
-                b = arr.shape[axis]
-                if batch is not None and b != batch:
-                    raise RuntimeError_(f"inconsistent batch sizes: {batch} vs {b} ({name!r})")
-                batch = b
-        if batch is None:
-            return None, {k: np.asarray(v) for k, v in inputs.items()}
-        bucket = next_bucket(batch)
+                size = arr.shape[axis]
+                if slot < len(dyn_sizes):
+                    if dyn_sizes[slot] != size:
+                        raise RuntimeError_(
+                            f"inconsistent dynamic dim {slot}: {dyn_sizes[slot]} vs "
+                            f"{size} ({name!r})"
+                        )
+                else:
+                    dyn_sizes.append(size)
+                slot += 1
+        if not dyn_sizes:
+            return [], {k: np.asarray(v) for k, v in inputs.items()}
+        buckets = [next_bucket(n) for n in dyn_sizes]
         padded: dict[str, np.ndarray] = {}
         for name, s in spec.items():
             arr = np.asarray(inputs[name], dtype=s.np_dtype())
-            if -1 in s.shape and bucket != batch:
-                axis = s.shape.index(-1)
-                pad = [(0, 0)] * arr.ndim
-                pad[axis] = (0, bucket - batch)
-                arr = np.pad(arr, pad)
-            padded[name] = arr
-        return batch, padded
+            pad = [(0, 0)] * arr.ndim
+            slot = 0
+            changed = False
+            for axis, d in enumerate(s.shape):
+                if d != -1:
+                    continue
+                if buckets[slot] != dyn_sizes[slot]:
+                    pad[axis] = (0, buckets[slot] - arr.shape[axis])
+                    changed = True
+                slot += 1
+            padded[name] = np.pad(arr, pad) if changed else arr
+        return dyn_sizes, padded
 
     # -- unload / introspection --------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
@@ -293,3 +338,5 @@ class TPUModelRuntime(BaseRuntime):
 
     def close(self) -> None:
         self._resident.clear()
+        with self._jit_lock:
+            self._jitted_by_key.clear()

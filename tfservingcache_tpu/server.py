@@ -39,7 +39,16 @@ class CacheNode:
         if runtime is None:
             from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
 
-            runtime = TPUModelRuntime(cfg.serving, self.metrics)
+            mesh = None
+            if cfg.mesh.chips_per_group > 1:
+                import jax
+
+                from tfservingcache_tpu.parallel.mesh import group_mesh
+
+                # this node serves chip group 0 of its local devices; the ring
+                # assigns models to nodes = chip groups (SURVEY.md §7 step 8)
+                mesh = group_mesh(jax.devices(), cfg.mesh.chips_per_group, 0)
+            runtime = TPUModelRuntime(cfg.serving, self.metrics, mesh=mesh)
         self.manager = CacheManager(provider, disk_cache, runtime, self.metrics)
         self.backend = LocalServingBackend(self.manager)
         self.rest = RestServingServer(
