@@ -41,9 +41,11 @@ def run_bench(family: str, tenants: int, warm_iters: int, batch: int) -> dict:
     model_def = build(family)
     rng = np.random.default_rng(0)
     inputs = {
-        name: rng.normal(size=tuple(batch if d == -1 else d for d in spec.shape)).astype(
-            spec.np_dtype()
-        )
+        name: rng.normal(
+            size=tuple(
+                batch if isinstance(d, str) else d for d in spec.norm_shape()
+            )
+        ).astype(spec.np_dtype())
         for name, spec in model_def.input_spec.items()
     }
 

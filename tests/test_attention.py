@@ -41,3 +41,11 @@ def test_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2, rtol=2e-2
     )
+
+
+def test_flash_uneven_blocks():
+    # block_k not dividing block_q's padding: lcm padding keeps both exact
+    q, k, v = rand_qkv(1, 2, 128, 64, seed=3)
+    ref = attention_reference(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False, block_q=128, block_k=48, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

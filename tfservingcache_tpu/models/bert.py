@@ -1,0 +1,182 @@
+"""BERT encoder family (BASELINE.json config #3: 100 per-tenant fine-tunes
+served from object storage). Bidirectional transformer encoder with a
+pooled classification head — the shape of a per-tenant fine-tune fleet:
+every tenant shares the arch (one XLA executable via the registry build
+cache) and differs only in weights.
+
+bf16 matmuls on the MXU, fp32 softmax/LN. Attention is mask-additive jnp
+(BERT sequences are <=512; the flash kernel's win is long-sequence memory,
+not this regime).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, register
+
+DEFAULT_CONFIG = {
+    "vocab_size": 30522,
+    "hidden": 768,
+    "n_layers": 12,
+    "n_heads": 12,
+    "d_ff": 3072,
+    "max_seq": 512,
+    "type_vocab": 2,
+    "num_labels": 2,
+    "dtype": "bfloat16",
+}
+
+TINY_CONFIG = {
+    "vocab_size": 512,
+    "hidden": 64,
+    "n_layers": 2,
+    "n_heads": 4,
+    "d_ff": 128,
+    "max_seq": 64,
+    "type_vocab": 2,
+    "num_labels": 3,
+    "dtype": "bfloat16",
+}
+
+
+def _layernorm(x, gain, bias, eps=1e-12):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * gain + bias).astype(x.dtype)
+
+
+def _encoder_layer(p, x, mask_bias, cfg):
+    b, s, d = x.shape
+    h = cfg["n_heads"]
+    hd = d // h
+    dtype = x.dtype
+
+    q = (x @ p["wq"] + p["bq"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    k = (x @ p["wk"] + p["bk"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    v = (x @ p["wv"] + p["bv"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+    scores = scores + mask_bias  # (b,1,1,s) additive -inf on padding
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, d)
+    x = _layernorm(x + (ctx @ p["wo"] + p["bo"]), p["ln1_g"], p["ln1_b"])
+    ff = jax.nn.gelu(x @ p["w1"] + p["b1"], approximate=True)
+    x = _layernorm(x + (ff @ p["w2"] + p["b2"]), p["ln2_g"], p["ln2_b"])
+    return x
+
+
+def _forward(params, input_ids, attention_mask, cfg):
+    dtype = jnp.dtype(cfg["dtype"])
+    s = input_ids.shape[1]
+    if s > cfg["max_seq"]:
+        # trace-time check: beyond the table, pos_emb gathers silently clamp
+        # and return confident garbage
+        raise ValueError(f"sequence length {s} exceeds max_seq {cfg['max_seq']}")
+    x = (
+        params["word_emb"][input_ids]
+        + params["pos_emb"][jnp.arange(s)][None]
+        + params["type_emb"][jnp.zeros_like(input_ids)]
+    ).astype(dtype)
+    x = _layernorm(x, params["emb_ln_g"], params["emb_ln_b"])
+    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30).astype(
+        jnp.float32
+    )
+    for layer in params["layers"]:
+        lp = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer)
+        lp["ln1_g"], lp["ln1_b"] = layer["ln1_g"], layer["ln1_b"]
+        lp["ln2_g"], lp["ln2_b"] = layer["ln2_g"], layer["ln2_b"]
+        x = _encoder_layer(lp, x, mask_bias, cfg)
+    pooled = jnp.tanh(x[:, 0, :] @ params["pool_w"].astype(dtype) + params["pool_b"])
+    logits = (pooled @ params["cls_w"].astype(dtype) + params["cls_b"]).astype(jnp.float32)
+    return logits, pooled.astype(jnp.float32)
+
+
+@register("bert", DEFAULT_CONFIG)
+def build(config: dict) -> ModelDef:
+    cfg = config
+
+    def apply(params, inputs):
+        logits, pooled = _forward(
+            params,
+            inputs["input_ids"].astype(jnp.int32),
+            inputs["attention_mask"].astype(jnp.int32),
+            cfg,
+        )
+        return {"logits": logits, "pooled_output": pooled}
+
+    def init(rng):
+        d, ff, v = cfg["hidden"], cfg["d_ff"], cfg["vocab_size"]
+        keys = jax.random.split(rng, cfg["n_layers"] + 2)
+
+        def dense(key, fan_in, shape):
+            return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+        layers = []
+        for i in range(cfg["n_layers"]):
+            ks = jax.random.split(keys[i], 6)
+            layers.append(
+                {
+                    "wq": dense(ks[0], d, (d, d)), "bq": jnp.zeros((d,)),
+                    "wk": dense(ks[1], d, (d, d)), "bk": jnp.zeros((d,)),
+                    "wv": dense(ks[2], d, (d, d)), "bv": jnp.zeros((d,)),
+                    "wo": dense(ks[3], d, (d, d)), "bo": jnp.zeros((d,)),
+                    "w1": dense(ks[4], d, (d, ff)), "b1": jnp.zeros((ff,)),
+                    "w2": dense(ks[5], ff, (ff, d)), "b2": jnp.zeros((d,)),
+                    "ln1_g": jnp.ones((d,)), "ln1_b": jnp.zeros((d,)),
+                    "ln2_g": jnp.ones((d,)), "ln2_b": jnp.zeros((d,)),
+                }
+            )
+        k_emb, k_head = keys[-2], keys[-1]
+        ke = jax.random.split(k_emb, 3)
+        kh = jax.random.split(k_head, 2)
+        return {
+            "word_emb": dense(ke[0], d, (v, d)),
+            "pos_emb": dense(ke[1], d, (cfg["max_seq"], d)),
+            "type_emb": dense(ke[2], d, (cfg["type_vocab"], d)),
+            "emb_ln_g": jnp.ones((d,)), "emb_ln_b": jnp.zeros((d,)),
+            "layers": layers,
+            "pool_w": dense(kh[0], d, (d, d)), "pool_b": jnp.zeros((d,)),
+            "cls_w": dense(kh[1], d, (d, cfg["num_labels"])),
+            "cls_b": jnp.zeros((cfg["num_labels"],)),
+        }
+
+    def loss(params, inputs, targets):
+        logits, _ = _forward(
+            params,
+            inputs["input_ids"].astype(jnp.int32),
+            inputs["attention_mask"].astype(jnp.int32),
+            cfg,
+        )
+        labels = jax.nn.one_hot(targets["label"], cfg["num_labels"])
+        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+
+    partition_rules = {
+        r"layers/\d+/w[qkv]": (None, "model"),
+        r"layers/\d+/wo": ("model", None),
+        r"layers/\d+/w1": (None, "model"),
+        r"layers/\d+/w2": ("model", None),
+        r"word_emb": (None, "model"),
+    }
+
+    return ModelDef(
+        family="bert",
+        config=cfg,
+        apply=apply,
+        init=init,
+        input_spec={
+            "input_ids": TensorSpec("int32", ("batch", "seq")),
+            "attention_mask": TensorSpec("int32", ("batch", "seq")),
+        },
+        output_spec={
+            "logits": TensorSpec("float32", (-1, cfg["num_labels"])),
+            "pooled_output": TensorSpec("float32", (-1, cfg["hidden"])),
+        },
+        partition_rules=partition_rules,
+        loss=loss,
+    )

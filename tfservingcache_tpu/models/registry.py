@@ -31,10 +31,19 @@ PARAMS_FILE = "params.msgpack"
 
 @dataclass(frozen=True)
 class TensorSpec:
-    """Shape uses -1 for the dynamic batch dimension."""
+    """Shape entries are ints (static) or axis-name strings (dynamic): the
+    same name must agree across all inputs of one request and buckets
+    independently of other names ("batch" + "seq" for LMs, "src"/"tgt" for
+    encoder-decoders). -1 is accepted as an alias for "batch"."""
 
     dtype: str
-    shape: tuple[int, ...]
+    shape: tuple[int | str, ...]
+
+    def norm_shape(self) -> tuple[int | str, ...]:
+        return tuple("batch" if d == -1 else d for d in self.shape)
+
+    def dynamic_axes(self) -> list[tuple[int, str]]:
+        return [(i, d) for i, d in enumerate(self.norm_shape()) if isinstance(d, str)]
 
     def np_dtype(self) -> np.dtype:
         import ml_dtypes  # registered extended dtypes (bfloat16)
@@ -115,7 +124,7 @@ def build(family: str, config: dict[str, Any] | None = None) -> ModelDef:
     return model
 
 
-_BUILTIN_MODULES = ("half_plus_two", "mnist_cnn", "bert", "resnet", "transformer_lm")
+_BUILTIN_MODULES = ("half_plus_two", "mnist_cnn", "bert", "resnet", "transformer_lm", "t5")
 
 
 def _load_builtin_families() -> None:

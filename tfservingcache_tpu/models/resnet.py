@@ -1,0 +1,136 @@
+"""ResNet family (BASELINE.json config #4: 1000 per-tenant variants hashed
+across chips). Standard bottleneck ResNet in flax; convs run bf16 on the
+MXU, batch-norm statistics are baked (inference mode) so apply stays a pure
+function of params.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, register
+
+DEFAULT_CONFIG = {"depth": 50, "num_classes": 1000, "width": 64, "image_size": 224}
+TINY_CONFIG = {"depth": 18, "num_classes": 10, "width": 8, "image_size": 32}
+
+_STAGES = {
+    18: (2, 2, 2, 2),
+    34: (3, 4, 6, 3),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+class _BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.GroupNorm, num_groups=32, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), strides=(self.strides, self.strides))(
+                residual
+            )
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class _BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.GroupNorm, num_groups=8, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class _ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int
+    width: int
+    bottleneck: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=min(32, self.width), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block = _BottleneckBlock if self.bottleneck else _BasicBlock
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = block(self.width * (2**i), strides=strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+@register("resnet", DEFAULT_CONFIG)
+def build(config: dict) -> ModelDef:
+    cfg = config
+    depth = cfg["depth"]
+    if depth not in _STAGES:
+        raise ValueError(f"unsupported resnet depth {depth}; known: {sorted(_STAGES)}")
+    module = _ResNet(
+        stage_sizes=_STAGES[depth],
+        num_classes=cfg["num_classes"],
+        width=cfg["width"],
+        bottleneck=depth >= 50,
+    )
+    size = cfg["image_size"]
+
+    def apply(params, inputs):
+        logits = module.apply({"params": params}, inputs["image"])
+        return {
+            "logits": logits,
+            "classes": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+        }
+
+    def init(rng):
+        return module.init(rng, jnp.zeros((1, size, size, 3), jnp.float32))["params"]
+
+    def loss(params, inputs, targets):
+        logits = module.apply({"params": params}, inputs["image"])
+        labels = jax.nn.one_hot(targets["label"], cfg["num_classes"])
+        return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+
+    return ModelDef(
+        family="resnet",
+        config=cfg,
+        apply=apply,
+        init=init,
+        input_spec={"image": TensorSpec("float32", (-1, size, size, 3))},
+        output_spec={
+            "logits": TensorSpec("float32", (-1, cfg["num_classes"])),
+            "classes": TensorSpec("int32", (-1,)),
+        },
+        loss=loss,
+    )

@@ -1,0 +1,241 @@
+"""T5-style encoder-decoder family (BASELINE.json config #5 lists T5-XL as a
+multi-chip shard target alongside Llama). RMSNorm, relative-position bias
+buckets, GeGLU feed-forward, tied embeddings — bf16 matmuls, fp32 softmax.
+
+Serving signature: (input_ids, decoder_input_ids) -> decoder logits, the
+predict shape for translation/summarization-style fine-tunes.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, register
+
+DEFAULT_CONFIG = {
+    "vocab_size": 32128,
+    "d_model": 512,
+    "n_layers": 6,
+    "n_heads": 8,
+    "d_ff": 1024,
+    "rel_buckets": 32,
+    "rel_max_dist": 128,
+    "dtype": "bfloat16",
+}
+
+TINY_CONFIG = {
+    "vocab_size": 256,
+    "d_model": 64,
+    "n_layers": 2,
+    "n_heads": 4,
+    "d_ff": 128,
+    "rel_buckets": 8,
+    "rel_max_dist": 32,
+    "dtype": "bfloat16",
+}
+
+
+def _rmsnorm(x, gain, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * gain.astype(x.dtype)
+
+
+def _rel_bucket(rel_pos, bidirectional, num_buckets, max_dist):
+    """T5 relative-position bucketing (log-spaced beyond num_buckets//2)."""
+    ret = jnp.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + jnp.where(n < 0, num_buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / math.log(max_dist / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+def _attn(p, q_in, kv_in, bias, cfg, extra_mask=None):
+    b, sq, d = q_in.shape
+    sk = kv_in.shape[1]
+    h = cfg["n_heads"]
+    hd = d // h
+    dtype = q_in.dtype
+    q = (q_in @ p["wq"]).reshape(b, sq, h, hd).transpose(0, 2, 1, 3)
+    k = (kv_in @ p["wk"]).reshape(b, sk, h, hd).transpose(0, 2, 1, 3)
+    v = (kv_in @ p["wv"]).reshape(b, sk, h, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
+    if extra_mask is not None:
+        scores = jnp.where(extra_mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v).transpose(0, 2, 1, 3).reshape(b, sq, d)
+    return ctx @ p["wo"]
+
+
+def _geglu(p, x):
+    return (jax.nn.gelu(x @ p["w0"], approximate=True) * (x @ p["w1"])) @ p["w2"]
+
+
+def _rel_bias(table, sq, sk, bidirectional, cfg):
+    pos_q = jnp.arange(sq)[:, None]
+    pos_k = jnp.arange(sk)[None, :]
+    buckets = _rel_bucket(
+        pos_k - pos_q, bidirectional, cfg["rel_buckets"], cfg["rel_max_dist"]
+    )
+    return table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)  # (1,h,sq,sk)
+
+
+def _forward(params, input_ids, decoder_input_ids, cfg):
+    dtype = jnp.dtype(cfg["dtype"])
+    cast = lambda tree: jax.tree_util.tree_map(lambda w: w.astype(dtype), tree)
+
+    # Token 0 is the pad token (T5 convention): padded src positions are
+    # masked out of encoder self-attention and cross-attention so the
+    # runtime's bucket padding cannot change valid-position logits.
+    src_valid = (input_ids != 0)[:, None, None, :]  # (b,1,1,s_src)
+
+    # encoder
+    x = params["embed"][input_ids].astype(dtype)
+    enc_bias = _rel_bias(params["enc_rel"], x.shape[1], x.shape[1], True, cfg)
+    for layer in params["enc_layers"]:
+        lp = cast(layer)
+        x = x + _attn(
+            lp["attn"], _rmsnorm(x, layer["ln1"]), _rmsnorm(x, layer["ln1"]),
+            enc_bias, cfg, extra_mask=src_valid,
+        )
+        x = x + _geglu(lp["mlp"], _rmsnorm(x, layer["ln2"]))
+    enc_out = _rmsnorm(x, params["enc_ln"])
+
+    # decoder
+    y = params["embed"][decoder_input_ids].astype(dtype)
+    sq = y.shape[1]
+    dec_bias = _rel_bias(params["dec_rel"], sq, sq, False, cfg)
+    causal = jnp.tril(jnp.ones((sq, sq), bool))[None, None]
+    for layer in params["dec_layers"]:
+        lp = cast(layer)
+        y = y + _attn(
+            lp["self_attn"], _rmsnorm(y, layer["ln1"]), _rmsnorm(y, layer["ln1"]),
+            dec_bias, cfg, extra_mask=causal,
+        )
+        y = y + _attn(
+            lp["cross_attn"], _rmsnorm(y, layer["ln2"]), enc_out, None, cfg,
+            extra_mask=src_valid,
+        )
+        y = y + _geglu(lp["mlp"], _rmsnorm(y, layer["ln3"]))
+    y = _rmsnorm(y, params["dec_ln"])
+    # tied embedding head, T5 1/sqrt(d) scaling
+    return ((y / math.sqrt(cfg["d_model"])) @ params["embed"].astype(dtype).T).astype(
+        jnp.float32
+    )
+
+
+@register("t5", DEFAULT_CONFIG)
+def build(config: dict) -> ModelDef:
+    cfg = config
+
+    def apply(params, inputs):
+        logits = _forward(
+            params,
+            inputs["input_ids"].astype(jnp.int32),
+            inputs["decoder_input_ids"].astype(jnp.int32),
+            cfg,
+        )
+        return {"logits": logits}
+
+    def init(rng):
+        d, ff, v, h = cfg["d_model"], cfg["d_ff"], cfg["vocab_size"], cfg["n_heads"]
+        keys = jax.random.split(rng, 2 * cfg["n_layers"] + 3)
+
+        def dense(key, fan_in, shape):
+            return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+        def attn_p(key):
+            ks = jax.random.split(key, 4)
+            return {
+                "wq": dense(ks[0], d, (d, d)),
+                "wk": dense(ks[1], d, (d, d)),
+                "wv": dense(ks[2], d, (d, d)),
+                "wo": dense(ks[3], d, (d, d)),
+            }
+
+        def mlp_p(key):
+            ks = jax.random.split(key, 3)
+            return {
+                "w0": dense(ks[0], d, (d, ff)),
+                "w1": dense(ks[1], d, (d, ff)),
+                "w2": dense(ks[2], ff, (ff, d)),
+            }
+
+        enc_layers = []
+        for i in range(cfg["n_layers"]):
+            ks = jax.random.split(keys[i], 2)
+            enc_layers.append(
+                {"attn": attn_p(ks[0]), "mlp": mlp_p(ks[1]),
+                 "ln1": jnp.ones((d,)), "ln2": jnp.ones((d,))}
+            )
+        dec_layers = []
+        for i in range(cfg["n_layers"]):
+            ks = jax.random.split(keys[cfg["n_layers"] + i], 3)
+            dec_layers.append(
+                {
+                    "self_attn": attn_p(ks[0]),
+                    "cross_attn": attn_p(ks[1]),
+                    "mlp": mlp_p(ks[2]),
+                    "ln1": jnp.ones((d,)), "ln2": jnp.ones((d,)), "ln3": jnp.ones((d,)),
+                }
+            )
+        return {
+            "embed": dense(keys[-3], d, (v, d)),
+            "enc_rel": dense(keys[-2], 1, (cfg["rel_buckets"], h)),
+            "dec_rel": dense(keys[-1], 1, (cfg["rel_buckets"], h)),
+            "enc_layers": enc_layers,
+            "dec_layers": dec_layers,
+            "enc_ln": jnp.ones((d,)),
+            "dec_ln": jnp.ones((d,)),
+        }
+
+    def loss(params, inputs, targets):
+        logits = _forward(
+            params,
+            inputs["input_ids"].astype(jnp.int32),
+            inputs["decoder_input_ids"].astype(jnp.int32),
+            cfg,
+        )
+        labels = targets["labels"].astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    partition_rules = {
+        r"embed": (None, "model"),
+        r"(enc|dec)_layers/\d+/(self_|cross_)?attn/w[qkv]": (None, "model"),
+        r"(enc|dec)_layers/\d+/(self_|cross_)?attn/wo": ("model", None),
+        r"(enc|dec)_layers/\d+/mlp/w[01]": (None, "model"),
+        r"(enc|dec)_layers/\d+/mlp/w2": ("model", None),
+    }
+
+    return ModelDef(
+        family="t5",
+        config=cfg,
+        apply=apply,
+        init=init,
+        input_spec={
+            "input_ids": TensorSpec("int32", ("batch", "src")),
+            "decoder_input_ids": TensorSpec("int32", ("batch", "tgt")),
+        },
+        output_spec={"logits": TensorSpec("float32", ("batch", "tgt", cfg["vocab_size"]))},
+        partition_rules=partition_rules,
+        loss=loss,
+    )

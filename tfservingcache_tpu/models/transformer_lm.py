@@ -183,8 +183,8 @@ def build(config: dict) -> ModelDef:
         config=cfg,
         apply=apply,
         init=init,
-        input_spec={"input_ids": TensorSpec("int32", (-1, -1))},
-        output_spec={"logits": TensorSpec("float32", (-1, -1, cfg["vocab_size"]))},
+        input_spec={"input_ids": TensorSpec("int32", ("batch", "seq"))},
+        output_spec={"logits": TensorSpec("float32", ("batch", "seq", cfg["vocab_size"]))},
         partition_rules=partition_rules,
         loss=loss,
     )

@@ -117,7 +117,7 @@ def flash_attention(
     sm_scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, max(s, 16))
     block_k = min(block_k, max(s, 16))
-    pad = (-s) % max(block_q, block_k)
+    pad = (-s) % math.lcm(block_q, block_k)  # both block counts must divide sp
     if pad:
         zeros = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
         q, k, v = zeros(q), zeros(k), zeros(v)

@@ -222,8 +222,8 @@ class LocalServingBackend(ServingBackend):
                 info = target[name]
                 info.name = f"{name}:0"
                 info.dtype = _NP_TO_DT_NAME.get(s.dtype, core.DT_INVALID)
-                for d in s.shape:
-                    info.tensor_shape.dim.add(size=d)
+                for d in s.norm_shape():
+                    info.tensor_shape.dim.add(size=-1 if isinstance(d, str) else d)
 
         fill(sig.inputs, in_spec)
         fill(sig.outputs, out_spec)
@@ -428,7 +428,12 @@ class LocalServingBackend(ServingBackend):
             return {
                 name: {
                     "dtype": s.dtype,
-                    "tensor_shape": {"dim": [{"size": str(d)} for d in s.shape]},
+                    "tensor_shape": {
+                        "dim": [
+                            {"size": str(-1 if isinstance(d, str) else d)}
+                            for d in s.norm_shape()
+                        ]
+                    },
                     "name": f"{name}:0",
                 }
                 for name, s in spec.items()

@@ -92,7 +92,9 @@ class TPUModelRuntime(BaseRuntime):
         # dropped when the last tenant is evicted, so executables don't pin
         # device memory after every user of them is gone.
         self._jitted_by_key: dict[str, tuple[Any, int]] = {}
-        self._jit_lock = threading.Lock()
+        # RLock: _resident.put below runs eviction callbacks (_on_evict takes
+        # this lock to decrement) in the inserting thread
+        self._jit_lock = threading.RLock()
 
     # -- load ---------------------------------------------------------------
     def ensure_loaded(self, model: Model) -> None:
@@ -139,17 +141,28 @@ class TPUModelRuntime(BaseRuntime):
                 hbm = tree_nbytes(params)
                 loaded = LoadedModel(model_def, params, jitted, hbm)
                 if self.cfg.warmup:
-                    self._warmup(loaded)
-                self._resident.put(mid, hbm, loaded)
+                    self._warmup(loaded)  # compile happens here, outside the lock
+                with self._jit_lock:
+                    # increment + insert atomically w.r.t. evictions: an
+                    # eviction of a same-family sibling between put and
+                    # increment would otherwise free the shared executable
+                    jfn, refs = self._jitted_by_key.get(key, (jitted, 0))
+                    self._jitted_by_key[key] = (jfn, refs + 1)
+                    try:
+                        self._resident.put(mid, hbm, loaded)
+                    except Exception:
+                        jfn, refs = self._jitted_by_key[key]
+                        if refs <= 1:
+                            del self._jitted_by_key[key]
+                        else:
+                            self._jitted_by_key[key] = (jfn, refs - 1)
+                        raise
             except Exception:
                 with self._jit_lock:
                     cur = self._jitted_by_key.get(key)
                     if created and cur is not None and cur[1] == 0:
                         del self._jitted_by_key[key]  # don't pin an executable no one uses
                 raise
-            with self._jit_lock:
-                jfn, refs = self._jitted_by_key.get(key, (jitted, 0))
-                self._jitted_by_key[key] = (jfn, refs + 1)
             self._set_state(mid, ModelState.AVAILABLE)
         except Exception as e:
             self._set_state(mid, ModelState.END)
@@ -176,7 +189,7 @@ class TPUModelRuntime(BaseRuntime):
 
     @staticmethod
     def _concrete_shape(spec: TensorSpec, batch: int) -> tuple[int, ...]:
-        return tuple(batch if d == -1 else d for d in spec.shape)
+        return tuple(batch if isinstance(d, str) else d for d in spec.norm_shape())
 
     # -- predict ------------------------------------------------------------
     def predict(
@@ -207,20 +220,15 @@ class TPUModelRuntime(BaseRuntime):
             if output_filter and name not in output_filter:
                 continue
             arr = np.asarray(arr)
-            # un-pad along every axis the output spec marks dynamic: the i-th
-            # -1 of each spec maps to the i-th shared dynamic size (batch,
-            # then seq, ...); fixed-shape outputs pass through whole
+            # un-pad along every named dynamic axis of the output spec using
+            # the sizes recorded from the inputs; fixed-shape outputs pass
+            # through whole
             ospec = out_spec.get(name)
             if ospec is not None and dyn_sizes:
-                slot = 0
-                for axis, d in enumerate(ospec.shape):
-                    if d != -1:
-                        continue
-                    if slot < len(dyn_sizes) and arr.ndim > axis:
-                        true = dyn_sizes[slot]
-                        if arr.shape[axis] > true:
-                            arr = np.take(arr, range(true), axis=axis)
-                    slot += 1
+                for axis, axis_name in ospec.dynamic_axes():
+                    true = dyn_sizes.get(axis_name)
+                    if true is not None and arr.ndim > axis and arr.shape[axis] > true:
+                        arr = np.take(arr, range(true), axis=axis)
             result[name] = arr
         if output_filter and not result:
             raise RuntimeError_(
@@ -230,50 +238,39 @@ class TPUModelRuntime(BaseRuntime):
 
     def _pad_to_bucket(
         self, spec: Mapping[str, TensorSpec], inputs: Mapping[str, np.ndarray]
-    ) -> tuple[list[int], dict[str, np.ndarray]]:
-        """-> (true dynamic sizes, padded inputs).
+    ) -> tuple[dict[str, int], dict[str, np.ndarray]]:
+        """-> (true size per named dynamic axis, padded inputs).
 
-        Every -1 axis is padded up to a power-of-two bucket. The i-th dynamic
-        axis of each input maps to shared slot i (slot 0 = batch, slot 1 =
-        sequence for LMs) and the sizes must agree across inputs.
+        Every named dynamic axis ("batch", "seq", ...) is padded up to its own
+        power-of-two bucket; the same name must agree across inputs.
         """
-        dyn_sizes: list[int] = []
+        dyn_sizes: dict[str, int] = {}
         for name, s in spec.items():
             arr = np.asarray(inputs[name])
-            slot = 0
-            for axis, d in enumerate(s.shape):
-                if d != -1:
-                    continue
+            for axis, axis_name in s.dynamic_axes():
                 if arr.ndim <= axis:
                     raise RuntimeError_(
                         f"input {name!r} needs at least {axis + 1} dims, got shape {arr.shape}"
                     )
                 size = arr.shape[axis]
-                if slot < len(dyn_sizes):
-                    if dyn_sizes[slot] != size:
-                        raise RuntimeError_(
-                            f"inconsistent dynamic dim {slot}: {dyn_sizes[slot]} vs "
-                            f"{size} ({name!r})"
-                        )
-                else:
-                    dyn_sizes.append(size)
-                slot += 1
+                if axis_name in dyn_sizes and dyn_sizes[axis_name] != size:
+                    raise RuntimeError_(
+                        f"inconsistent {axis_name!r} dim: {dyn_sizes[axis_name]} vs "
+                        f"{size} ({name!r})"
+                    )
+                dyn_sizes[axis_name] = size
         if not dyn_sizes:
-            return [], {k: np.asarray(v) for k, v in inputs.items()}
-        buckets = [next_bucket(n) for n in dyn_sizes]
+            return {}, {k: np.asarray(v) for k, v in inputs.items()}
+        buckets = {n: next_bucket(v) for n, v in dyn_sizes.items()}
         padded: dict[str, np.ndarray] = {}
         for name, s in spec.items():
             arr = np.asarray(inputs[name], dtype=s.np_dtype())
             pad = [(0, 0)] * arr.ndim
-            slot = 0
             changed = False
-            for axis, d in enumerate(s.shape):
-                if d != -1:
-                    continue
-                if buckets[slot] != dyn_sizes[slot]:
-                    pad[axis] = (0, buckets[slot] - arr.shape[axis])
+            for axis, axis_name in s.dynamic_axes():
+                if buckets[axis_name] != arr.shape[axis]:
+                    pad[axis] = (0, buckets[axis_name] - arr.shape[axis])
                     changed = True
-                slot += 1
             padded[name] = np.pad(arr, pad) if changed else arr
         return dyn_sizes, padded
 
