@@ -151,3 +151,36 @@ def test_bert_rejects_overlong_sequence():
                 "attention_mask": np.ones((1, 70), np.int32),
             },
         )
+
+
+def test_bert_non_power_of_two_max_seq_served(tmp_path):
+    """The runtime's power-of-two bucket padding must clamp at BERT's pos-table
+    cap (ModelDef.axis_caps): with max_seq=48, a 40-token request pads to 48
+    (not 64, which the forward pass would reject), and a 50-token request gets
+    a clear error instead of confident garbage."""
+    from tfservingcache_tpu.runtime.base import RuntimeError_
+
+    cfg = dict(BERT_TINY, max_seq=48)
+    export_artifact("bert", str(tmp_path), name="b48", version=1, config=cfg)
+    rt = TPUModelRuntime(ServingConfig())
+    try:
+        model = Model(identifier=ModelId("b48", 1), path=str(tmp_path / "b48" / "1"))
+        rt.ensure_loaded(model)
+        out = rt.predict(
+            model.identifier,
+            {
+                "input_ids": np.ones((1, 40), np.int32),
+                "attention_mask": np.ones((1, 40), np.int32),
+            },
+        )
+        assert out["logits"].shape[0] == 1
+        with pytest.raises(RuntimeError_, match="exceeds this model's maximum"):
+            rt.predict(
+                model.identifier,
+                {
+                    "input_ids": np.ones((1, 50), np.int32),
+                    "attention_mask": np.ones((1, 50), np.int32),
+                },
+            )
+    finally:
+        rt.close()

@@ -24,7 +24,9 @@ def create_provider(cfg) -> "ModelProvider":
         if t in ("gcs", "gcsprovider"):
             from tfservingcache_tpu.cache.providers.gcs import GCSModelProvider
 
-            return GCSModelProvider(bucket=cfg.bucket, base_path=cfg.base_path)
+            return GCSModelProvider(
+                bucket=cfg.bucket, base_path=cfg.base_path, endpoint=cfg.endpoint
+            )
         if t in ("azblob", "azblobprovider"):
             from tfservingcache_tpu.cache.providers.azblob import AZBlobModelProvider
 
@@ -33,6 +35,7 @@ def create_provider(cfg) -> "ModelProvider":
                 account_key=cfg.account_key,
                 container=cfg.container,
                 base_path=cfg.base_path,
+                endpoint=cfg.endpoint,
             )
     except ImportError as e:
         raise ProviderError(

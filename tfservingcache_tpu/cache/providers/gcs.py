@@ -1,0 +1,112 @@
+"""GCS model provider over the JSON API (storage/v1).
+
+No reference equivalent — the reference covers disk/S3/Azure (SURVEY.md §2
+C8-C10); GCS is the natural third cloud on TPU-VMs (SURVEY.md §2 C9
+"TPU-equiv" note) and follows the same provider pattern: paginated list under
+``<basePath>/<model>/<version>/``, per-object download, size = sum of listed
+sizes, health = 1-key list.
+
+Auth: bearer token from (in order) ``GCS_ACCESS_TOKEN`` env, or the GCE/TPU-VM
+metadata server's default service account. Anonymous when neither is
+available (public buckets, test fakes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+import urllib.request
+
+from tfservingcache_tpu.cache.providers.base import ProviderError
+from tfservingcache_tpu.cache.providers.object_store import (
+    ObjectInfo,
+    ObjectStoreProvider,
+    http_call,
+    http_download,
+)
+
+_METADATA_TOKEN_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "service-accounts/default/token"
+)
+
+
+class GCSModelProvider(ObjectStoreProvider):
+    def __init__(self, bucket: str, base_path: str = "", endpoint: str = "") -> None:
+        super().__init__(base_path)
+        if not bucket:
+            raise ProviderError("gcs provider requires a bucket")
+        self.bucket = bucket
+        self._base_url = (endpoint or "https://storage.googleapis.com").rstrip("/")
+        self._token = ""
+        self._token_expiry = 0.0
+        self._no_metadata = False  # negative-cache: off-GCP hosts stay anonymous
+
+    # -- auth ----------------------------------------------------------------
+    def _bearer_token(self) -> str:
+        env = os.environ.get("GCS_ACCESS_TOKEN", "")
+        if env:
+            return env
+        if self._token and time.monotonic() < self._token_expiry - 60:
+            return self._token
+        if self._no_metadata:
+            return ""
+        req = urllib.request.Request(
+            _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            status, _, body = http_call(req, timeout=2.0, retries=1)
+        except ProviderError:
+            self._no_metadata = True
+            return ""  # not on GCP: anonymous
+        if status != 200:
+            return ""
+        tok = json.loads(body)
+        self._token = tok.get("access_token", "")
+        self._token_expiry = time.monotonic() + float(tok.get("expires_in", 0))
+        return self._token
+
+    def _request(self, url: str) -> urllib.request.Request:
+        req = urllib.request.Request(url)
+        token = self._bearer_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        return req
+
+    # -- ObjectStoreProvider primitives -------------------------------------
+    def _list_page(
+        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0
+    ) -> tuple[list[ObjectInfo], list[str], str]:
+        params = {
+            "prefix": prefix,
+            "fields": "items(name,size),prefixes,nextPageToken",
+        }
+        if delimiter:
+            params["delimiter"] = delimiter
+        if marker:
+            params["pageToken"] = marker
+        if max_keys:
+            params["maxResults"] = str(max_keys)
+        url = (
+            f"{self._base_url}/storage/v1/b/{urllib.parse.quote(self.bucket)}/o"
+            f"?{urllib.parse.urlencode(sorted(params.items()))}"
+        )
+        status, _, body = http_call(self._request(url))
+        if status != 200:
+            raise ProviderError(f"gcs list failed: HTTP {status}: {body[:300]!r}")
+        data = json.loads(body)
+        objects = [
+            ObjectInfo(key=item["name"], size=int(item.get("size", 0)))
+            for item in data.get("items", [])
+        ]
+        prefixes = list(data.get("prefixes", []))
+        return objects, prefixes, data.get("nextPageToken", "")
+
+    def _download(self, key: str, dest_path: str) -> None:
+        url = (
+            f"{self._base_url}/storage/v1/b/{urllib.parse.quote(self.bucket)}/o/"
+            f"{urllib.parse.quote(key, safe='')}?alt=media"
+        )
+        http_download(lambda: self._request(url), dest_path)

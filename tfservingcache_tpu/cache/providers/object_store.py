@@ -1,0 +1,219 @@
+"""Shared machinery for object-store model providers (S3 / GCS / Azure Blob).
+
+Reference equivalents: pkg/cachemanager/modelproviders/s3modelprovider/
+s3modelprovider.go and .../azblobmodelprovider/azblobmodelprovider.go. Both
+follow the same pattern (SURVEY.md §2 C9/C10): paginated list of every object
+under ``<basePath>/<model>/<version>/`` + per-object download
+(s3modelprovider.go:124-159 modelObjectApply), ``model_size`` as the sum of
+listed object sizes (s3modelprovider.go:108-122), health = a 1-key list
+(s3modelprovider.go:172-181), and an error when the listing comes back empty
+(azblobmodelprovider.go:157-159). That pattern is factored here once; the
+backends only supply one page of listing and one object download.
+
+The cloud SDKs (boto3 / google-cloud-storage / azure-storage-blob) are not
+part of this image, so the backends speak the stores' plain HTTP APIs with
+stdlib urllib — which also makes them testable against in-process fake
+servers, unlike the reference's SDK-bound providers (SURVEY.md §4: "S3/azBlob
+providers ... no fakes").
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import shutil
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+from tfservingcache_tpu.cache.providers.base import (
+    ModelNotFoundError,
+    ModelProvider,
+    ProviderError,
+    atomic_dest,
+)
+from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("providers.objectstore")
+
+_RETRIES = 3
+_RETRY_BACKOFF_S = 0.25
+
+
+@dataclass(frozen=True)
+class ObjectInfo:
+    key: str
+    size: int
+
+
+def http_call(
+    req: urllib.request.Request, timeout: float = 30.0, retries: int = _RETRIES
+) -> tuple[int, dict[str, str], bytes]:
+    """One HTTP round-trip with bounded retries on 5xx / connection errors.
+
+    The reference leans on SDK-internal retry policy; a small explicit one
+    keeps behavior observable.
+    """
+    last_err: Exception | None = None
+    for attempt in range(retries):
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, dict(resp.headers.items()), resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            if e.code >= 500 and attempt + 1 < retries:
+                last_err = e
+            else:
+                return e.code, dict(e.headers.items()), body
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            last_err = e
+        time.sleep(_RETRY_BACKOFF_S * (2**attempt))
+    raise ProviderError(f"object store unreachable after {retries} attempts: {last_err}")
+
+
+def http_download(
+    make_req: Callable[[], urllib.request.Request],
+    dest_path: str,
+    timeout: float = 120.0,
+    retries: int = _RETRIES,
+) -> None:
+    """Stream a GET response straight to ``dest_path`` (multi-GB artifacts
+    must not transit host RAM whole). ``make_req`` builds a fresh request per
+    attempt so time-sensitive auth headers (SigV4 x-amz-date, Azure
+    x-ms-date) stay valid across retries."""
+    last_err: Exception | None = None
+    for attempt in range(retries):
+        try:
+            with urllib.request.urlopen(make_req(), timeout=timeout) as resp:
+                with open(dest_path, "wb") as fh:
+                    shutil.copyfileobj(resp, fh, length=1 << 20)
+                return
+        except urllib.error.HTTPError as e:
+            body = e.read()[:300]
+            if e.code >= 500 and attempt + 1 < retries:
+                last_err = e
+            else:
+                raise ProviderError(f"download failed: HTTP {e.code}: {body!r}") from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            last_err = e
+        time.sleep(_RETRY_BACKOFF_S * (2**attempt))
+    raise ProviderError(f"download failed after {retries} attempts: {last_err}")
+
+
+class ObjectStoreProvider(ModelProvider):
+    """Template for providers over a flat key/value object store.
+
+    Key layout mirrors the reference (s3modelprovider.go:161-170):
+    ``<base_path>/<model>/<version>/<artifact files...>``. Like the disk
+    provider (and diskmodelprovider.go:46-69), the version segment matches by
+    numeric value, so a store dir ``000000042`` serves version 42.
+    """
+
+    def __init__(self, base_path: str) -> None:
+        self.base_path = base_path.strip("/")
+
+    # -- backend primitives -------------------------------------------------
+    @abc.abstractmethod
+    def _list_page(
+        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0
+    ) -> tuple[list[ObjectInfo], list[str], str]:
+        """One page of listing -> (objects, common-prefixes, next-marker).
+        Empty next-marker = last page; ``max_keys`` 0 = backend default."""
+
+    @abc.abstractmethod
+    def _download(self, key: str, dest_path: str) -> None:
+        """Fetch one object to a local file."""
+
+    # -- shared listing helpers ---------------------------------------------
+    def _list_all(self, prefix: str, delimiter: str = "") -> Iterator[tuple[ObjectInfo | None, str | None]]:
+        """Iterate every (object, None) and (None, common_prefix) under
+        ``prefix`` across pages (reference pagination loops
+        s3modelprovider.go:130-158 / azblobmodelprovider.go:125-162)."""
+        marker = ""
+        while True:
+            objects, prefixes, marker = self._list_page(prefix, delimiter, marker)
+            for o in objects:
+                yield o, None
+            for p in prefixes:
+                yield None, p
+            if not marker:
+                return
+
+    def _prefix_for(self, name: str, version: int) -> str:
+        parts = [p for p in (self.base_path, name) if p]
+        return "/".join(parts) + f"/{self._resolve_version_dir(name, version)}/"
+
+    def _resolve_version_dir(self, name: str, version: int) -> str:
+        """Find the stored version-directory segment whose numeric value equals
+        ``version`` (zero-padded dirs serve their numeric version, like the
+        disk provider). Exact match short-circuits without a list call."""
+        base = "/".join(p for p in (self.base_path, name) if p) + "/"
+        exact_probe, _, _ = self._list_page(f"{base}{version}/", "", "", max_keys=1)
+        if exact_probe:
+            return str(version)
+        for _, common in self._list_all(base, delimiter="/"):
+            if common is None:
+                continue
+            seg = common[len(base):].strip("/")
+            try:
+                if int(seg) == version:
+                    return seg
+            except ValueError:
+                continue
+        raise ModelNotFoundError(f"version {version} of model {name!r} not found under {base!r}")
+
+    def _list_model_objects(self, name: str, version: int) -> tuple[list[ObjectInfo], str]:
+        """-> (objects, resolved prefix). The prefix is resolved exactly once —
+        resolution may itself cost a paginated listing for zero-padded version
+        dirs, so callers must not re-derive it."""
+        prefix = self._prefix_for(name, version)
+        objects = [o for o, _ in self._list_all(prefix) if o is not None]
+        if not objects:
+            # reference azblobmodelprovider.go:157-159: zero blobs is an error
+            raise ModelNotFoundError(f"no objects under {prefix!r}")
+        return objects, prefix
+
+    # -- ModelProvider interface --------------------------------------------
+    def load_model(self, name: str, version: int, dest_dir: str) -> Model:
+        objects, prefix = self._list_model_objects(name, version)
+        total = 0
+        with atomic_dest(dest_dir) as tmp:
+            for obj in objects:
+                rel = obj.key[len(prefix):]
+                if not rel or rel.endswith("/"):
+                    continue  # zero-byte "directory" placeholder objects
+                local = os.path.join(tmp, *rel.split("/"))
+                os.makedirs(os.path.dirname(local), exist_ok=True)
+                self._download(obj.key, local)
+                total += obj.size
+        log.info("downloaded %s/%d: %d objects, %d bytes", name, version, len(objects), total)
+        return Model(
+            identifier=ModelId(name, version), path=dest_dir, size_on_disk=total
+        )
+
+    def model_size(self, name: str, version: int) -> int:
+        """Sum of listed object sizes (reference s3modelprovider.go:108-122)."""
+        objects, _ = self._list_model_objects(name, version)
+        return sum(o.size for o in objects)
+
+    def latest_version(self, name: str) -> int:
+        base = "/".join(p for p in (self.base_path, name) if p) + "/"
+        versions = []
+        for _, common in self._list_all(base, delimiter="/"):
+            if common is None:
+                continue
+            seg = common[len(base):].strip("/")
+            try:
+                versions.append(int(seg))
+            except ValueError:
+                continue
+        if not versions:
+            raise ModelNotFoundError(f"no versions of model {name!r} under {base!r}")
+        return max(versions)
+
+    def check(self) -> None:
+        """Health probe = 1-key list (reference s3modelprovider.go:172-181)."""
+        self._list_page(self.base_path + "/" if self.base_path else "", "", "", max_keys=1)

@@ -178,5 +178,9 @@ def build(config: dict) -> ModelDef:
             "pooled_output": TensorSpec("float32", (-1, cfg["hidden"])),
         },
         partition_rules=partition_rules,
+        # the absolute pos_emb table bounds servable sequence length; the
+        # runtime clamps its padding bucket here so a 300-token request under
+        # max_seq=384 pads to 384, not 512 (which _forward would reject)
+        axis_caps={"seq": cfg["max_seq"]},
         loss=loss,
     )

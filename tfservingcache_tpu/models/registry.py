@@ -73,6 +73,10 @@ class ModelDef:
     # mesh-axis partition rules for multi-chip serving, e.g.
     # {("dense", "kernel"): (None, "model")}; consumed by parallel.sharding
     partition_rules: dict[str, Any] = field(default_factory=dict)
+    # hard upper bound per named dynamic axis (e.g. {"seq": max_seq} for
+    # absolute-position-table models): the runtime clamps its power-of-two
+    # padding bucket to the cap and rejects true sizes beyond it
+    axis_caps: dict[str, int] = field(default_factory=dict)
     # loss(params, inputs, targets) for families that support training steps
     loss: Callable[..., Any] | None = None
 

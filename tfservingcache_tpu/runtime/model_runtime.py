@@ -211,7 +211,7 @@ class TPUModelRuntime(BaseRuntime):
         if unknown:
             raise RuntimeError_(f"unknown inputs {sorted(unknown)} for {model_id}")
 
-        dyn_sizes, padded = self._pad_to_bucket(spec, inputs)
+        dyn_sizes, padded = self._pad_to_bucket(spec, inputs, loaded.model_def.axis_caps)
         out = loaded.jitted(loaded.params, padded)
         out = jax.device_get(out)
         out_spec = loaded.model_def.output_spec
@@ -237,12 +237,17 @@ class TPUModelRuntime(BaseRuntime):
         return result
 
     def _pad_to_bucket(
-        self, spec: Mapping[str, TensorSpec], inputs: Mapping[str, np.ndarray]
+        self,
+        spec: Mapping[str, TensorSpec],
+        inputs: Mapping[str, np.ndarray],
+        axis_caps: Mapping[str, int] | None = None,
     ) -> tuple[dict[str, int], dict[str, np.ndarray]]:
         """-> (true size per named dynamic axis, padded inputs).
 
         Every named dynamic axis ("batch", "seq", ...) is padded up to its own
-        power-of-two bucket; the same name must agree across inputs.
+        power-of-two bucket; the same name must agree across inputs. A capped
+        axis (ModelDef.axis_caps, e.g. BERT's pos-table max_seq) clamps the
+        bucket to the cap and rejects true sizes beyond it.
         """
         dyn_sizes: dict[str, int] = {}
         for name, s in spec.items():
@@ -261,7 +266,17 @@ class TPUModelRuntime(BaseRuntime):
                 dyn_sizes[axis_name] = size
         if not dyn_sizes:
             return {}, {k: np.asarray(v) for k, v in inputs.items()}
-        buckets = {n: next_bucket(v) for n, v in dyn_sizes.items()}
+        caps = axis_caps or {}
+        for axis_name, size in dyn_sizes.items():
+            cap = caps.get(axis_name)
+            if cap is not None and size > cap:
+                raise RuntimeError_(
+                    f"{axis_name!r} dim {size} exceeds this model's maximum {cap}"
+                )
+        buckets = {
+            n: min(next_bucket(v), caps[n]) if n in caps else next_bucket(v)
+            for n, v in dyn_sizes.items()
+        }
         padded: dict[str, np.ndarray] = {}
         for name, s in spec.items():
             arr = np.asarray(inputs[name], dtype=s.np_dtype())
