@@ -1,0 +1,404 @@
+"""Consul / etcd / Kubernetes discovery backends against in-process fake
+servers speaking each system's wire protocol.
+
+The reference ships these backends untested (SURVEY.md §4 "all three
+discovery backends" untested); speaking plain HTTP lets protocol-correct
+fakes drive registration, heartbeats, watch streams, and membership deltas.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+
+from aiohttp import web
+
+from tfservingcache_tpu.cluster.discovery.consul import ConsulDiscoveryService
+from tfservingcache_tpu.cluster.discovery.etcd import EtcdDiscoveryService, prefix_range_end
+from tfservingcache_tpu.cluster.discovery.kubernetes import K8sDiscoveryService
+from tfservingcache_tpu.types import NodeInfo
+
+
+async def wait_for(queue: asyncio.Queue, predicate, timeout=5.0):
+    """Drain membership snapshots until one satisfies ``predicate``."""
+    async with asyncio.timeout(timeout):
+        while True:
+            nodes = await queue.get()
+            if predicate(nodes):
+                return nodes
+
+
+def idents(nodes):
+    return sorted(n.ident for n in nodes)
+
+
+# --------------------------------------------------------------------------
+# Consul
+# --------------------------------------------------------------------------
+class FakeConsul:
+    def __init__(self):
+        self.registrations: dict[str, dict] = {}
+        self.beats: list[tuple[str, str]] = []  # (verb, check_id)
+        self.deregistered: list[str] = []
+        self.health_error = False  # when set, health answers 500
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_put("/v1/agent/service/register", self.register)
+        app.router.add_put("/v1/agent/check/{verb}/{check}", self.beat)
+        app.router.add_get("/v1/health/service/{name}", self.health)
+        app.router.add_put("/v1/agent/service/deregister/{sid}", self.deregister)
+        return app
+
+    async def register(self, req):
+        body = await req.json()
+        self.registrations[body["ID"]] = body
+        return web.Response()
+
+    async def beat(self, req):
+        self.beats.append((req.match_info["verb"], req.match_info["check"]))
+        return web.Response()
+
+    async def health(self, req):
+        if self.health_error:
+            return web.Response(status=500, text="leader election")
+        entries = [
+            {"Service": {"Address": r["Address"], "Tags": r["Tags"]}}
+            for r in self.registrations.values()
+            if r["Name"] == req.match_info["name"]
+        ]
+        return web.json_response(entries)
+
+    async def deregister(self, req):
+        sid = req.match_info["sid"]
+        self.deregistered.append(sid)
+        self.registrations.pop(sid, None)
+        return web.Response()
+
+
+async def wait_until(cond, timeout=5.0):
+    async with asyncio.timeout(timeout):
+        while not cond():
+            await asyncio.sleep(0.01)
+
+
+async def serve_app(app):
+    # short shutdown: the fakes' watch handlers block in q.get() until
+    # cancelled, and cleanup() waits shutdown_timeout for them
+    runner = web.AppRunner(app, shutdown_timeout=0.2)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def test_consul_register_heartbeat_and_poll():
+    fake = FakeConsul()
+    runner, url = await serve_app(fake.app())
+    svc = ConsulDiscoveryService(url, "tpusc", ttl_s=0.2, poll_interval_s=0.05)
+    try:
+        q = svc.subscribe()
+        await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
+        reg = fake.registrations[svc.service_id]
+        assert set(reg["Tags"]) == {"rest:8094", "grpc:8095"}
+        assert reg["Check"]["TTL"] == "0.2s"
+        assert reg["Check"]["DeregisterCriticalServiceAfter"] == "20s"  # 100x ttl
+        nodes = await wait_for(q, lambda ns: len(ns) == 1)
+        assert nodes[0].ident == "10.0.0.1:8094:8095"
+        # a second node appears in health results -> snapshot grows
+        fake.registrations["other"] = {
+            "Name": "tpusc", "ID": "other", "Address": "10.0.0.2",
+            "Tags": ["rest:8094", "grpc:8095"],
+        }
+        await wait_for(q, lambda ns: idents(ns) == ["10.0.0.1:8094:8095", "10.0.0.2:8094:8095"])
+        await asyncio.sleep(0.25)  # at least one ttl/2 beat
+        assert ("pass", f"service:{svc.service_id}") in fake.beats
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+    assert svc.service_id in fake.deregistered
+
+
+async def test_consul_unhealthy_heartbeats_fail():
+    fake = FakeConsul()
+    runner, url = await serve_app(fake.app())
+    svc = ConsulDiscoveryService(url, "tpusc", ttl_s=0.1, poll_interval_s=1.0)
+    try:
+        await svc.register(NodeInfo("10.0.0.1", 1, 2), lambda: False)
+        await asyncio.sleep(0.2)
+        assert any(verb == "fail" for verb, _ in fake.beats)
+        assert not any(verb == "pass" for verb, _ in fake.beats)
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+
+
+async def test_consul_entry_missing_or_malformed_tags_skipped():
+    fake = FakeConsul()
+    runner, url = await serve_app(fake.app())
+    fake.registrations["bad"] = {"Name": "tpusc", "ID": "bad", "Address": "10.9.9.9", "Tags": []}
+    fake.registrations["worse"] = {
+        "Name": "tpusc", "ID": "worse", "Address": "10.9.9.8",
+        "Tags": ["rest:abc", "grpc:1"],  # unparseable port must not kill the poll task
+    }
+    svc = ConsulDiscoveryService(url, "tpusc", ttl_s=1.0, poll_interval_s=0.05)
+    try:
+        q = svc.subscribe()
+        await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
+        nodes = await wait_for(q, lambda ns: len(ns) == 1)
+        assert nodes[0].host == "10.0.0.1"  # bad entries dropped, not crashed
+        # poll task must still be alive after the malformed entries: a new
+        # healthy peer keeps flowing through
+        fake.registrations["peer"] = {
+            "Name": "tpusc", "ID": "peer", "Address": "10.0.0.2",
+            "Tags": ["rest:8094", "grpc:8095"],
+        }
+        await wait_for(q, lambda ns: len(ns) == 2)
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+
+
+async def test_consul_agent_error_does_not_wipe_membership():
+    """A transient 500 from the agent (e.g. leader election) must not be
+    published as an empty peer list — that would atomically wipe every
+    subscriber's ring."""
+    fake = FakeConsul()
+    runner, url = await serve_app(fake.app())
+    svc = ConsulDiscoveryService(url, "tpusc", ttl_s=1.0, poll_interval_s=0.03)
+    try:
+        q = svc.subscribe()
+        await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
+        await wait_for(q, lambda ns: len(ns) == 1)
+        fake.health_error = True
+        await asyncio.sleep(0.2)  # several failing polls
+        assert q.empty(), "error poll must not publish a membership change"
+        fake.health_error = False
+        fake.registrations["peer"] = {
+            "Name": "tpusc", "ID": "peer", "Address": "10.0.0.2",
+            "Tags": ["rest:1", "grpc:2"],
+        }
+        await wait_for(q, lambda ns: len(ns) == 2)  # recovered
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+
+
+# --------------------------------------------------------------------------
+# etcd (v3 JSON gateway)
+# --------------------------------------------------------------------------
+def b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+class FakeEtcd:
+    def __init__(self):
+        self.kv: dict[str, str] = {}
+        self.lease_grants = 0
+        self.watchers: list[asyncio.Queue] = []
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v3/lease/grant", self.lease_grant)
+        app.router.add_post("/v3/kv/put", self.put)
+        app.router.add_post("/v3/kv/range", self.range)
+        app.router.add_post("/v3/kv/deleterange", self.delete)
+        app.router.add_post("/v3/watch", self.watch)
+        return app
+
+    async def lease_grant(self, req):
+        self.lease_grants += 1
+        return web.json_response({"ID": str(7000 + self.lease_grants)})
+
+    def _notify(self, ev_type: str, key: str, value: str = ""):
+        ev = {"type": ev_type, "kv": {"key": b64(key)}}
+        if value:
+            ev["kv"]["value"] = b64(value)
+        for q in self.watchers:
+            q.put_nowait(ev)
+
+    async def put(self, req):
+        body = await req.json()
+        key, value = unb64(body["key"]), unb64(body["value"])
+        self.kv[key] = value
+        self._notify("PUT", key, value)
+        return web.json_response({})
+
+    async def range(self, req):
+        body = await req.json()
+        start = unb64(body["key"])
+        kvs = [
+            {"key": b64(k), "value": b64(v)}
+            for k, v in sorted(self.kv.items())
+            if k.startswith(start)
+        ]
+        return web.json_response({"kvs": kvs})
+
+    async def delete(self, req):
+        body = await req.json()
+        key = unb64(body["key"])
+        if key in self.kv:
+            del self.kv[key]
+            self._notify("DELETE", key)
+        return web.json_response({})
+
+    async def watch(self, req):
+        resp = web.StreamResponse()
+        await resp.prepare(req)
+        q: asyncio.Queue = asyncio.Queue()
+        self.watchers.append(q)
+        try:
+            while True:
+                ev = await q.get()
+                line = json.dumps({"result": {"events": [ev]}}) + "\n"
+                await resp.write(line.encode())
+        finally:
+            self.watchers.remove(q)
+        return resp
+
+
+async def test_etcd_register_watch_and_expiry():
+    fake = FakeEtcd()
+    runner, url = await serve_app(fake.app())
+    svc = EtcdDiscoveryService(url, "tpusc", ttl_s=1.0)
+    try:
+        q = svc.subscribe()
+        await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
+        assert fake.kv[svc.self_key] == "10.0.0.1:8094:8095"
+        assert fake.lease_grants >= 1
+        await wait_for(q, lambda ns: idents(ns) == ["10.0.0.1:8094:8095"])
+        await wait_until(lambda: fake.watchers)  # watch stream established
+        # a peer's key appears -> PUT watch event -> snapshot grows
+        fake.kv["/service/tpusc/peer1"] = "10.0.0.2:8094:8095"
+        fake._notify("PUT", "/service/tpusc/peer1", "10.0.0.2:8094:8095")
+        await wait_for(q, lambda ns: len(ns) == 2)
+        # lease expiry (simulated) -> DELETE event -> peer drops from snapshot
+        del fake.kv["/service/tpusc/peer1"]
+        fake._notify("DELETE", "/service/tpusc/peer1")
+        await wait_for(q, lambda ns: idents(ns) == ["10.0.0.1:8094:8095"])
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+    assert svc.self_key not in fake.kv  # deregistered
+
+
+async def test_etcd_heartbeat_regrants_lease():
+    fake = FakeEtcd()
+    runner, url = await serve_app(fake.app())
+    svc = EtcdDiscoveryService(url, "tpusc", ttl_s=1.0)  # clamped minimum; beat at 0.5s
+    try:
+        await svc.register(NodeInfo("10.0.0.1", 1, 2), lambda: True)
+        grants0 = fake.lease_grants
+        await asyncio.sleep(0.7)
+        assert fake.lease_grants > grants0  # fresh lease per beat (liveness=expiry)
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+
+
+def test_etcd_prefix_range_end():
+    assert unb64(prefix_range_end("/service/a/")) == "/service/a0"  # '/'+1 == '0'
+    assert unb64(prefix_range_end("abc")) == "abd"
+
+
+# --------------------------------------------------------------------------
+# Kubernetes (Endpoints watch)
+# --------------------------------------------------------------------------
+class FakeK8s:
+    def __init__(self):
+        self.endpoints: dict[str, dict] = {}
+        self.watchers: list[asyncio.Queue] = []
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/api/v1/namespaces/{ns}/endpoints", self.endpoints_handler)
+        return app
+
+    def push_event(self, ev_type: str, obj: dict):
+        name = obj["metadata"]["name"]
+        if ev_type == "DELETED":
+            self.endpoints.pop(name, None)
+        else:
+            self.endpoints[name] = obj
+        for q in self.watchers:
+            q.put_nowait({"type": ev_type, "object": obj})
+
+    async def endpoints_handler(self, req):
+        if req.query.get("watch") != "1":
+            return web.json_response(
+                {"items": list(self.endpoints.values()), "metadata": {"resourceVersion": "1"}}
+            )
+        resp = web.StreamResponse()
+        await resp.prepare(req)
+        q: asyncio.Queue = asyncio.Queue()
+        self.watchers.append(q)
+        try:
+            while True:
+                ev = await q.get()
+                await resp.write((json.dumps(ev) + "\n").encode())
+        finally:
+            self.watchers.remove(q)
+        return resp
+
+
+def endpoints_obj(name: str, ips: list[str], with_ports=True, extra_subset=None):
+    subset: dict = {"addresses": [{"ip": ip} for ip in ips]}
+    if with_ports:
+        subset["ports"] = [{"name": "rest", "port": 8094}, {"name": "grpc", "port": 8095}]
+    subsets = [subset]
+    if extra_subset is not None:
+        subsets.append(extra_subset)
+    return {"metadata": {"name": name}, "subsets": subsets}
+
+
+async def test_k8s_list_then_watch_events(tmp_path):
+    fake = FakeK8s()
+    fake.endpoints["tpusc"] = endpoints_obj("tpusc", ["10.0.0.1", "10.0.0.2"])
+    runner, url = await serve_app(fake.app())
+    svc = K8sDiscoveryService(
+        "tpusc", namespace="prod", api_url=url, sa_dir=str(tmp_path), poll_interval_s=0.05
+    )
+    try:
+        assert svc.field_selector == "metadata.name=tpusc"
+        q = svc.subscribe()
+        await svc.register(NodeInfo("ignored", 0, 0), lambda: True)  # no-op + watch start
+        await wait_for(q, lambda ns: idents(ns) == [
+            "10.0.0.1:8094:8095", "10.0.0.2:8094:8095",
+        ])
+        await wait_until(lambda: fake.watchers)  # watch stream established
+        # scale-up event: full rebuild from the event object
+        fake.push_event("MODIFIED", endpoints_obj("tpusc", ["10.0.0.1", "10.0.0.2", "10.0.0.3"]))
+        await wait_for(q, lambda ns: len(ns) == 3)
+        # object deleted -> empty membership
+        fake.push_event("DELETED", endpoints_obj("tpusc", []))
+        await wait_for(q, lambda ns: ns == [])
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+
+
+async def test_k8s_unnamed_ports_skipped_and_namespace_from_sa(tmp_path):
+    (tmp_path / "namespace").write_text("team-ns\n")
+    (tmp_path / "token").write_text("sekrit")
+    fake = FakeK8s()
+    fake.endpoints["tpusc"] = endpoints_obj(
+        "tpusc", ["10.0.0.1"],
+        extra_subset={"addresses": [{"ip": "10.0.9.9"}], "ports": [{"name": "http", "port": 80}]},
+    )
+    runner, url = await serve_app(fake.app())
+    svc = K8sDiscoveryService("tpusc", api_url=url, sa_dir=str(tmp_path))
+    try:
+        assert svc.namespace == "team-ns"
+        q = svc.subscribe()
+        await svc.register(NodeInfo("x", 0, 0), lambda: True)
+        nodes = await wait_for(q, lambda ns: len(ns) == 1)
+        assert nodes[0].host == "10.0.0.1"  # unnamed-port subset skipped
+    finally:
+        await svc.unregister()
+        await runner.cleanup()

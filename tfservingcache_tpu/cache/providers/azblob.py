@@ -102,7 +102,8 @@ class AZBlobModelProvider(ObjectStoreProvider):
 
     # -- ObjectStoreProvider primitives -------------------------------------
     def _list_page(
-        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0
+        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0,
+        timeout: float = 10.0, retries: int = 3,
     ) -> tuple[list[ObjectInfo], list[str], str]:
         params = {"restype": "container", "comp": "list", "prefix": prefix}
         if delimiter:
@@ -112,7 +113,7 @@ class AZBlobModelProvider(ObjectStoreProvider):
         if max_keys:
             params["maxresults"] = str(max_keys)
         url = f"{self._base_url}?{urllib.parse.urlencode(sorted(params.items()))}"
-        status, _, body = http_call(self._request(url), timeout=10.0)
+        status, _, body = http_call(self._request(url), timeout=timeout, retries=retries)
         if status != 200:
             raise ProviderError(f"azblob list failed: HTTP {status}: {body[:300]!r}")
         root = ET.fromstring(body)

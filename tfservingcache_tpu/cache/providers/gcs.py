@@ -62,6 +62,10 @@ class GCSModelProvider(ObjectStoreProvider):
             self._no_metadata = True
             return ""  # not on GCP: anonymous
         if status != 200:
+            # negative-cache non-200 too (e.g. 404 when the instance has no
+            # default service account): without it every list page and object
+            # download would serially repeat the metadata round-trip
+            self._no_metadata = True
             return ""
         tok = json.loads(body)
         self._token = tok.get("access_token", "")
@@ -77,7 +81,8 @@ class GCSModelProvider(ObjectStoreProvider):
 
     # -- ObjectStoreProvider primitives -------------------------------------
     def _list_page(
-        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0
+        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0,
+        timeout: float = 30.0, retries: int = 3,
     ) -> tuple[list[ObjectInfo], list[str], str]:
         params = {
             "prefix": prefix,
@@ -93,7 +98,7 @@ class GCSModelProvider(ObjectStoreProvider):
             f"{self._base_url}/storage/v1/b/{urllib.parse.quote(self.bucket)}/o"
             f"?{urllib.parse.urlencode(sorted(params.items()))}"
         )
-        status, _, body = http_call(self._request(url))
+        status, _, body = http_call(self._request(url), timeout=timeout, retries=retries)
         if status != 200:
             raise ProviderError(f"gcs list failed: HTTP {status}: {body[:300]!r}")
         data = json.loads(body)

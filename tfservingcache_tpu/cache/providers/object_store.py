@@ -118,7 +118,13 @@ class ObjectStoreProvider(ModelProvider):
     # -- backend primitives -------------------------------------------------
     @abc.abstractmethod
     def _list_page(
-        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0
+        self,
+        prefix: str,
+        delimiter: str,
+        marker: str,
+        max_keys: int = 0,
+        timeout: float = 30.0,
+        retries: int = _RETRIES,
     ) -> tuple[list[ObjectInfo], list[str], str]:
         """One page of listing -> (objects, common-prefixes, next-marker).
         Empty next-marker = last page; ``max_keys`` 0 = backend default."""
@@ -215,5 +221,11 @@ class ObjectStoreProvider(ModelProvider):
         return max(versions)
 
     def check(self) -> None:
-        """Health probe = 1-key list (reference s3modelprovider.go:172-181)."""
-        self._list_page(self.base_path + "/" if self.base_path else "", "", "", max_keys=1)
+        """Health probe = 1-key list, bounded like the reference's
+        10s-timeout health list (s3modelprovider.go:172-181 /
+        azblobmodelprovider.go:174-186) — a black-holed endpoint must fail
+        the probe in ~10s, not stall a liveness loop for minutes of retries."""
+        self._list_page(
+            self.base_path + "/" if self.base_path else "", "", "",
+            max_keys=1, timeout=10.0, retries=1,
+        )

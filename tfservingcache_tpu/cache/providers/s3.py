@@ -132,7 +132,8 @@ class S3ModelProvider(ObjectStoreProvider):
 
     # -- ObjectStoreProvider primitives -------------------------------------
     def _list_page(
-        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0
+        self, prefix: str, delimiter: str, marker: str, max_keys: int = 0,
+        timeout: float = 30.0, retries: int = 3,
     ) -> tuple[list[ObjectInfo], list[str], str]:
         params = {"list-type": "2", "prefix": prefix}
         if delimiter:
@@ -142,7 +143,7 @@ class S3ModelProvider(ObjectStoreProvider):
         if max_keys:
             params["max-keys"] = str(max_keys)
         url = f"{self._base_url}?{urllib.parse.urlencode(sorted(params.items()))}"
-        status, _, body = http_call(self._request(url))
+        status, _, body = http_call(self._request(url), timeout=timeout, retries=retries)
         if status != 200:
             raise ProviderError(f"s3 list failed: HTTP {status}: {body[:300]!r}")
         ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}

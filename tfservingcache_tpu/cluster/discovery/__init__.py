@@ -35,6 +35,7 @@ def _create(cfg, t: str) -> "DiscoveryService":
             namespace=cfg.namespace,
             field_selector=cfg.field_selector,
             poll_interval_s=cfg.poll_interval_s,
+            api_url=cfg.address,  # "" = in-cluster env
         )
     if t == "consul":
         from tfservingcache_tpu.cluster.discovery.consul import ConsulDiscoveryService

@@ -1,0 +1,165 @@
+"""Consul discovery over the HTTP agent/health APIs.
+
+Reference equivalent: pkg/taskhandler/discovery/consul/consul.go (C15 in
+SURVEY.md §2). Semantics kept:
+  - registration encodes the two ports in tags ``rest:<p>`` / ``grpc:<p>``
+    with a TTL check that auto-deregisters after 100×ttl (consul.go:49-67);
+  - heartbeats pass/fail from the injected health fn every ttl/2
+    (consul.go:138-160);
+  - peers discovered by polling ``/v1/health/service/<name>?passing`` every
+    ``poll_interval_s`` (consul.go:70-117, hardcoded 5s there).
+The consul/api Go SDK becomes plain aiohttp against the same endpoints, so
+tests can run a protocol-correct fake agent in-process (the reference never
+tested this backend — SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Callable
+
+import aiohttp
+
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("discovery.consul")
+
+DEREGISTER_AFTER_TTL_MULTIPLE = 100  # reference consul.go:58-61
+
+
+class ConsulDiscoveryService(DiscoveryService):
+    def __init__(
+        self,
+        address: str,
+        service_name: str,
+        ttl_s: float = 5.0,
+        poll_interval_s: float = 5.0,
+    ) -> None:
+        super().__init__()
+        self.base = (address or "http://127.0.0.1:8500").rstrip("/")
+        self.service_name = service_name
+        self.ttl_s = ttl_s
+        self.poll_interval_s = poll_interval_s
+        self.service_id = f"{service_name}-{uuid.uuid4().hex[:12]}"
+        self._session: aiohttp.ClientSession | None = None
+        self._tasks: list[asyncio.Task] = []
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=10.0)
+            )
+        return self._session
+
+    async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        session = await self._ensure_session()
+        body = {
+            "Name": self.service_name,
+            "ID": self.service_id,
+            "Address": self_node.host,
+            "Port": self_node.rest_port,
+            # ports ride tags, reference consul.go:49-56
+            "Tags": [f"rest:{self_node.rest_port}", f"grpc:{self_node.grpc_port}"],
+            "Check": {
+                "TTL": f"{self.ttl_s:g}s",
+                "DeregisterCriticalServiceAfter": f"{self.ttl_s * DEREGISTER_AFTER_TTL_MULTIPLE:g}s",
+            },
+        }
+        async with session.put(
+            f"{self.base}/v1/agent/service/register", json=body
+        ) as resp:
+            if resp.status != 200:
+                raise ConnectionError(
+                    f"consul register failed: HTTP {resp.status}: {await resp.text()}"
+                )
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop(is_healthy)))
+        self._tasks.append(asyncio.create_task(self._poll_loop()))
+        log.info("registered %s with consul at %s", self.service_id, self.base)
+
+    async def _heartbeat_loop(self, is_healthy: Callable[[], bool]) -> None:
+        """TTL check pass/fail every ttl/2 (reference consul.go:138-160)."""
+        session = await self._ensure_session()
+        while True:
+            verb = "pass" if is_healthy() else "fail"
+            try:
+                async with session.put(
+                    f"{self.base}/v1/agent/check/{verb}/service:{self.service_id}"
+                ) as resp:
+                    if resp.status != 200:
+                        log.warning("consul heartbeat %s: HTTP %d", verb, resp.status)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                log.warning("consul heartbeat failed: %s", e)
+            await asyncio.sleep(self.ttl_s / 2)
+
+    async def _poll_loop(self) -> None:
+        """Passing-only service poll (reference consul.go:70-117)."""
+        session = await self._ensure_session()
+        last: list[str] | None = None
+        while True:
+            try:
+                async with session.get(
+                    f"{self.base}/v1/health/service/{self.service_name}",
+                    params={"passing": "1"},
+                ) as resp:
+                    if resp.status == 200:
+                        entries = await resp.json()
+                    else:
+                        # a transient agent error (leader election 500) must
+                        # not be mistaken for "zero peers" — publishing []
+                        # would atomically wipe every subscriber's ring
+                        log.warning("consul poll: HTTP %d", resp.status)
+                        entries = None
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+                log.warning("consul poll failed: %s", e)
+                entries = None
+            if entries is not None:
+                nodes = []
+                for entry in entries:
+                    svc = entry.get("Service", {})
+                    node = self._node_from_service(svc)
+                    if node is not None:
+                        nodes.append(node)
+                idents = sorted(n.ident for n in nodes)
+                if idents != last:
+                    last = idents
+                    self._publish(nodes)
+            await asyncio.sleep(self.poll_interval_s)
+
+    @staticmethod
+    def _node_from_service(svc: dict) -> NodeInfo | None:
+        host = svc.get("Address", "")
+        rest = grpc = None
+        try:
+            for tag in svc.get("Tags", []) or []:
+                if tag.startswith("rest:"):
+                    rest = int(tag[5:])
+                elif tag.startswith("grpc:"):
+                    grpc = int(tag[5:])
+        except ValueError:
+            # one peer's malformed tag must degrade to "skip that peer", not
+            # kill the poll task for this node's lifetime
+            log.warning("consul entry has malformed port tag: %r", svc)
+            return None
+        if not host or rest is None or grpc is None:
+            log.warning("consul entry missing address/port tags: %r", svc)
+            return None
+        return NodeInfo(host=host, rest_port=rest, grpc_port=grpc)
+
+    async def unregister(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        if self._session is not None and not self._session.closed:
+            try:
+                async with self._session.put(
+                    f"{self.base}/v1/agent/service/deregister/{self.service_id}"
+                ) as resp:
+                    if resp.status != 200:
+                        log.warning("consul deregister: HTTP %d", resp.status)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                log.warning("consul deregister failed: %s", e)
+            await self._session.close()
+            self._session = None

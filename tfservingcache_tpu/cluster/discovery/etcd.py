@@ -1,0 +1,176 @@
+"""etcd discovery over the v3 JSON gRPC-gateway (``/v3/...`` HTTP endpoints).
+
+Reference equivalent: pkg/taskhandler/discovery/etcd/etcd.go (C14 in
+SURVEY.md §2). Semantics kept:
+  - self-registration by leased KV heartbeat: every ttl/2, grant a fresh
+    lease of ttl seconds and put ``/service/<name>/<uuid> = host:rest:grpc``
+    under it, so a dead node's key expires within ttl (etcd.go:134-148);
+  - peers discovered via a prefix watch with create/modify/delete delta
+    tracking over an initial range read (etcd.go:58-116).
+The etcd clientv3 Go SDK becomes the gateway's JSON mapping of the same
+RPCs (Range/Put/DeleteRange/LeaseGrant/Watch; keys and values are base64
+in the JSON encoding), so an in-process fake gateway can drive tests — the
+reference never tested this backend (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import uuid
+from typing import Callable
+
+import aiohttp
+
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.net import aiter_lines
+
+log = get_logger("discovery.etcd")
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def prefix_range_end(prefix: str) -> str:
+    """etcd prefix query upper bound: prefix with its last byte incremented."""
+    b = bytearray(prefix.encode())
+    for i in range(len(b) - 1, -1, -1):
+        if b[i] < 0xFF:
+            b[i] += 1
+            return base64.b64encode(bytes(b[: i + 1])).decode()
+        del b[i]
+    return base64.b64encode(b"\x00").decode()  # whole keyspace
+
+
+class EtcdDiscoveryService(DiscoveryService):
+    def __init__(self, address: str, service_name: str, ttl_s: float = 5.0) -> None:
+        super().__init__()
+        self.base = (address or "http://127.0.0.1:2379").rstrip("/")
+        self.prefix = f"/service/{service_name}/"
+        self.ttl_s = max(ttl_s, 1.0)
+        self.self_key = f"{self.prefix}{uuid.uuid4().hex}"
+        self._session: aiohttp.ClientSession | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._nodes: dict[str, NodeInfo] = {}  # key -> node (delta tracking)
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            # no total timeout: the watch request streams indefinitely
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10.0)
+            )
+        return self._session
+
+    async def _post(self, path: str, body: dict) -> dict:
+        session = await self._ensure_session()
+        async with session.post(f"{self.base}{path}", json=body) as resp:
+            text = await resp.text()
+            if resp.status != 200:
+                raise ConnectionError(f"etcd {path} failed: HTTP {resp.status}: {text}")
+            return json.loads(text)
+
+    async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        await self._heartbeat_once(self_node.ident)  # fail fast if etcd is down
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop(self_node, is_healthy)))
+        self._tasks.append(asyncio.create_task(self._watch_loop()))
+        log.info("registered %s in etcd at %s", self.self_key, self.base)
+
+    async def _heartbeat_once(self, ident: str) -> None:
+        """Grant a fresh ttl lease + put our key under it (reference
+        etcd.go:134-148 does exactly this per beat: liveness = lease expiry)."""
+        lease = await self._post("/v3/lease/grant", {"TTL": int(self.ttl_s)})
+        lease_id = lease.get("ID")
+        await self._post(
+            "/v3/kv/put",
+            {"key": _b64(self.self_key), "value": _b64(ident), "lease": lease_id},
+        )
+
+    async def _heartbeat_loop(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        while True:
+            await asyncio.sleep(self.ttl_s / 2)
+            # an unhealthy node skips the beat; its lease expires and the ring
+            # drops it (the reference's etcd backend has no health hook — the
+            # consul one does; this unifies the two behaviors)
+            if not is_healthy():
+                log.warning("skipping etcd heartbeat: node unhealthy")
+                continue
+            try:
+                await self._heartbeat_once(self_node.ident)
+            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError) as e:
+                log.warning("etcd heartbeat failed: %s", e)
+
+    # -- membership ---------------------------------------------------------
+    async def _load_initial(self) -> None:
+        data = await self._post(
+            "/v3/kv/range",
+            {"key": _b64(self.prefix), "range_end": prefix_range_end(self.prefix)},
+        )
+        self._nodes.clear()
+        for kv in data.get("kvs", []) or []:
+            self._accept(_unb64(kv["key"]), _unb64(kv["value"]))
+        self._publish(list(self._nodes.values()))
+
+    def _accept(self, key: str, value: str) -> None:
+        try:
+            self._nodes[key] = NodeInfo.from_ident(value)
+        except ValueError:
+            log.warning("bad node ident under %s: %r", key, value)
+
+    async def _watch_loop(self) -> None:
+        """Prefix watch with reconnect; each (re)connect re-reads the full
+        range first so deltas apply to fresh state (reference etcd.go:58-116)."""
+        session = await self._ensure_session()
+        body = json.dumps(
+            {
+                "create_request": {
+                    "key": _b64(self.prefix),
+                    "range_end": prefix_range_end(self.prefix),
+                }
+            }
+        )
+        while True:
+            try:
+                await self._load_initial()
+                async with session.post(f"{self.base}/v3/watch", data=body) as resp:
+                    if resp.status != 200:
+                        raise ConnectionError(f"watch HTTP {resp.status}")
+                    async for line in aiter_lines(resp):
+                        msg = json.loads(line)
+                        self._apply_watch_events(msg.get("result", msg))
+            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+                log.warning("etcd watch interrupted: %s; reconnecting", e)
+                await asyncio.sleep(min(self.ttl_s, 2.0))
+
+    def _apply_watch_events(self, result: dict) -> None:
+        events = result.get("events", []) or []
+        changed = False
+        for ev in events:
+            kv = ev.get("kv", {})
+            key = _unb64(kv.get("key", ""))
+            if ev.get("type") == "DELETE":
+                changed |= self._nodes.pop(key, None) is not None
+            else:  # PUT covers create + modify
+                self._accept(key, _unb64(kv.get("value", "")))
+                changed = True
+        if changed:
+            self._publish(list(self._nodes.values()))
+
+    async def unregister(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        if self._session is not None and not self._session.closed:
+            try:
+                await self._post("/v3/kv/deleterange", {"key": _b64(self.self_key)})
+            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError) as e:
+                log.warning("etcd deregister failed: %s", e)
+            await self._session.close()
+            self._session = None

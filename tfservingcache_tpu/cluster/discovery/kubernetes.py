@@ -1,0 +1,185 @@
+"""Kubernetes discovery: in-cluster Endpoints watch.
+
+Reference equivalent: pkg/taskhandler/discovery/kubernetes/kubernetes.go
+(C16 in SURVEY.md §2 — the primary backend for TPU pod slices). Semantics
+kept:
+  - watches ``Endpoints`` objects matching a field selector and rebuilds the
+    full node map on every event (kubernetes.go:79-152);
+  - ports resolved by *named* endpoint ports — ``rest`` and ``grpc``
+    (kubernetes.go named-service-port resolution);
+  - self-registration is a no-op: k8s owns membership via the Service's
+    selector + readiness (kubernetes.go:154-157);
+  - namespace read from the serviceaccount file when not configured
+    (kubernetes.go:169-180).
+client-go becomes a plain aiohttp streaming watch against the API server
+(bearer token + cluster CA from the serviceaccount mount), so tests can run
+a fake API server in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import ssl
+from typing import Callable
+
+import aiohttp
+
+from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
+from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.net import aiter_lines
+
+log = get_logger("discovery.k8s")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+REST_PORT_NAME = "rest"
+GRPC_PORT_NAME = "grpc"
+
+
+class K8sDiscoveryService(DiscoveryService):
+    def __init__(
+        self,
+        service_name: str,
+        namespace: str = "",
+        field_selector: str = "",
+        poll_interval_s: float = 2.0,
+        api_url: str = "",
+        sa_dir: str = SA_DIR,
+    ) -> None:
+        super().__init__()
+        self.service_name = service_name
+        self.sa_dir = sa_dir
+        self.namespace = namespace or self._read_sa_file("namespace") or "default"
+        # default selector: the Endpoints object that shares the Service name
+        self.field_selector = field_selector or f"metadata.name={service_name}"
+        self.poll_interval_s = poll_interval_s
+        if not api_url:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise ValueError(
+                    "k8s discovery needs an in-cluster environment "
+                    "(KUBERNETES_SERVICE_HOST) or an explicit api_url"
+                )
+            api_url = f"https://{host}:{port}"
+        self.api_url = api_url.rstrip("/")
+        self._session: aiohttp.ClientSession | None = None
+        self._task: asyncio.Task | None = None
+        self._endpoints: dict[str, list[NodeInfo]] = {}  # object name -> nodes
+
+    def _read_sa_file(self, name: str) -> str:
+        try:
+            with open(os.path.join(self.sa_dir, name)) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def _ssl_context(self) -> ssl.SSLContext | bool:
+        ca = os.path.join(self.sa_dir, "ca.crt")
+        if self.api_url.startswith("https://") and os.path.exists(ca):
+            return ssl.create_default_context(cafile=ca)
+        return False if self.api_url.startswith("http://") else True
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            headers = {}
+            token = self._read_sa_file("token")
+            if token:
+                headers["Authorization"] = f"Bearer {token}"
+            self._session = aiohttp.ClientSession(
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10.0),
+                connector=aiohttp.TCPConnector(ssl=self._ssl_context()),
+            )
+        return self._session
+
+    async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        """No-op registration (reference kubernetes.go:154-157): the pod joins
+        the Endpoints via its Service selector + readiness probe; this only
+        starts the watch."""
+        del self_node, is_healthy
+        self._task = asyncio.create_task(self._watch_loop())
+
+    # -- watch --------------------------------------------------------------
+    def _endpoints_url(self, watch: bool, resource_version: str = "") -> str:
+        params = [f"fieldSelector={self.field_selector}"]
+        if watch:
+            params.append("watch=1")
+        if resource_version:
+            params.append(f"resourceVersion={resource_version}")
+        return (
+            f"{self.api_url}/api/v1/namespaces/{self.namespace}/endpoints"
+            f"?{'&'.join(params)}"
+        )
+
+    async def _watch_loop(self) -> None:
+        session = await self._ensure_session()
+        while True:
+            try:
+                # initial LIST for full state + a resourceVersion to watch from
+                async with session.get(self._endpoints_url(watch=False)) as resp:
+                    if resp.status != 200:
+                        raise ConnectionError(f"endpoints list HTTP {resp.status}")
+                    data = await resp.json()
+                self._endpoints.clear()
+                for obj in data.get("items", []) or []:
+                    self._apply("ADDED", obj, publish=False)
+                self._publish(self._flatten())
+                rv = data.get("metadata", {}).get("resourceVersion", "")
+                async with session.get(self._endpoints_url(watch=True, resource_version=rv)) as resp:
+                    if resp.status != 200:
+                        raise ConnectionError(f"endpoints watch HTTP {resp.status}")
+                    async for line in aiter_lines(resp):
+                        event = json.loads(line)
+                        self._apply(event.get("type", ""), event.get("object", {}))
+            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+                log.warning("k8s endpoints watch interrupted: %s; reconnecting", e)
+                await asyncio.sleep(self.poll_interval_s)
+
+    def _apply(self, ev_type: str, obj: dict, publish: bool = True) -> None:
+        name = obj.get("metadata", {}).get("name", "")
+        if not name:
+            return
+        if ev_type == "DELETED":
+            self._endpoints.pop(name, None)
+        else:  # ADDED / MODIFIED: rebuild this object's node list whole
+            self._endpoints[name] = self._nodes_from_endpoints(obj)
+        if publish:
+            self._publish(self._flatten())
+
+    def _flatten(self) -> list[NodeInfo]:
+        return [n for nodes in self._endpoints.values() for n in nodes]
+
+    @staticmethod
+    def _nodes_from_endpoints(obj: dict) -> list[NodeInfo]:
+        """addresses × named ports per subset (reference kubernetes.go:96-152;
+        only ready addresses count — notReadyAddresses are excluded)."""
+        nodes: list[NodeInfo] = []
+        for subset in obj.get("subsets", []) or []:
+            rest = grpc = None
+            for port in subset.get("ports", []) or []:
+                if port.get("name") == REST_PORT_NAME:
+                    rest = int(port["port"])
+                elif port.get("name") == GRPC_PORT_NAME:
+                    grpc = int(port["port"])
+            if rest is None or grpc is None:
+                log.warning(
+                    "endpoints %s subset lacks named ports %r/%r; skipping",
+                    obj.get("metadata", {}).get("name"), REST_PORT_NAME, GRPC_PORT_NAME,
+                )
+                continue
+            for addr in subset.get("addresses", []) or []:
+                ip = addr.get("ip", "")
+                if ip:
+                    nodes.append(NodeInfo(host=ip, rest_port=rest, grpc_port=grpc))
+        return nodes
+
+    async def unregister(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+            self._session = None

@@ -3,6 +3,24 @@
 from __future__ import annotations
 
 import socket
+from typing import AsyncIterator
+
+
+async def aiter_lines(resp) -> AsyncIterator[bytes]:
+    """Yield newline-delimited records from an aiohttp streaming response.
+
+    ``async for line in resp.content`` readline-caps at 64 KiB and raises on
+    longer lines — a single k8s Endpoints watch event for a few hundred pods
+    exceeds that — so buffer arbitrary chunks and split explicitly."""
+    buf = b""
+    async for chunk in resp.content.iter_any():
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line.strip():
+                yield line
+    if buf.strip():
+        yield buf
 
 
 def outbound_ip(probe_addr: tuple[str, int] = ("8.8.8.8", 80)) -> str:
