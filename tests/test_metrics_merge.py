@@ -1,0 +1,121 @@
+"""Merged /metrics endpoint (reference MetricsHandler scrape-merge,
+pkg/taskhandler/metrics.go:16-53 and its test metrics_test.go:14-60: own
+counter + scraped text-format metrics both present in one output)."""
+
+from __future__ import annotations
+
+import aiohttp
+from aiohttp import web
+
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.utils.metrics import Metrics, scrape_and_merge
+
+
+async def serve_exporter(text: str, status: int = 200):
+    async def handler(req):
+        return web.Response(status=status, text=text)
+
+    app = web.Application()
+    app.router.add_get("/metrics", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}/metrics"
+
+
+FAKE_TPU_METRICS = (
+    "# HELP libtpu_hbm_used_bytes HBM in use\n"
+    "# TYPE libtpu_hbm_used_bytes gauge\n"
+    "libtpu_hbm_used_bytes 12345\n"
+)
+
+
+async def test_scrape_and_merge_appends_valid_target():
+    m = Metrics()
+    m.request_count.labels("rest").inc()
+    runner, url = await serve_exporter(FAKE_TPU_METRICS)
+    try:
+        merged = await scrape_and_merge(m.render(), [url])
+    finally:
+        await runner.cleanup()
+    assert b"tfservingcache_proxy_requests_total" in merged
+    assert b"libtpu_hbm_used_bytes 12345" in merged
+
+
+async def test_scrape_and_merge_skips_bad_targets():
+    m = Metrics()
+    down = "http://127.0.0.1:1/metrics"
+    runner, err_url = await serve_exporter("", status=500)
+    runner2, bad_url = await serve_exporter("{{{ not prometheus text")
+    try:
+        merged = await scrape_and_merge(m.render(), [down, err_url, bad_url])
+    finally:
+        await runner.cleanup()
+        await runner2.cleanup()
+    # own metrics survive; no corrupt upstream text leaks in
+    assert b"tpusc_models_resident" in merged
+    assert b"{{{" not in merged
+
+
+async def test_scrape_and_merge_dedups_cross_exporter_families():
+    """Two exporters both shipping python_gc_*-style default families must
+    not produce duplicate families (Prometheus rejects the whole scrape)."""
+    m = Metrics()
+    own = m.render()
+    overlap = (
+        "# HELP tpusc_models_resident duplicate of our own gauge\n"
+        "# TYPE tpusc_models_resident gauge\n"
+        "tpusc_models_resident 999\n"
+        "# HELP sidecar_only_metric fine\n"
+        "# TYPE sidecar_only_metric counter\n"
+        'sidecar_only_metric_total{src="a b",q="x\\"y"} 7.0\n'
+    )
+    r1, url1 = await serve_exporter(overlap)
+    r2, url2 = await serve_exporter(overlap)  # second copy: dedup across targets too
+    try:
+        merged = (await scrape_and_merge(own, [url1, url2])).decode()
+    finally:
+        await r1.cleanup()
+        await r2.cleanup()
+    assert merged.count("# TYPE tpusc_models_resident gauge") == 1
+    assert "tpusc_models_resident 999" not in merged  # own registry wins
+    assert merged.count("# TYPE sidecar_only_metric counter") == 1
+    assert 'sidecar_only_metric_total{q="x\\"y",src="a b"} 7.0' in merged
+    from prometheus_client.parser import text_string_to_metric_families
+
+    names = [f.name for f in text_string_to_metric_families(merged)]
+    assert len(names) == len(set(names))  # exposition is duplicate-free
+
+
+async def test_rest_metrics_endpoint_merges(tmp_path):
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.runtime.fake import FakeRuntime
+
+    exporter_runner, url = await serve_exporter(FAKE_TPU_METRICS)
+    m = Metrics()
+    manager = CacheManager(
+        DiskModelProvider(str(tmp_path)), ModelDiskCache(str(tmp_path / "c"), 1 << 20),
+        FakeRuntime(), m,
+    )
+    rest = RestServingServer(
+        LocalServingBackend(manager), m,
+        metrics_path="/monitoring/prometheus/metrics",
+        metrics_scrape_targets=[url],
+    )
+    port = await rest.start(0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{port}/monitoring/prometheus/metrics"
+            ) as resp:
+                body = await resp.text()
+    finally:
+        await rest.close()
+        await exporter_runner.cleanup()
+    assert "libtpu_hbm_used_bytes" in body
+    assert "tpusc_models_resident" in body

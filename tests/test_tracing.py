@@ -1,0 +1,114 @@
+"""Per-stage tracing (greenfield — SURVEY.md §5: the reference has none)."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import aiohttp
+import numpy as np
+
+from tfservingcache_tpu.utils.tracing import TRACER, Tracer
+
+
+def test_span_nesting_and_ring_buffer():
+    t = Tracer(capacity=3)
+    with t.span("root", model="m:1"):
+        with t.span("fetch"):
+            pass
+        with t.span("infer"):
+            pass
+    traces = t.recent()
+    assert len(traces) == 1
+    root = traces[0]
+    assert root["name"] == "root" and root["attrs"] == {"model": "m:1"}
+    assert [c["name"] for c in root["children"]] == ["fetch", "infer"]
+    assert all(c["duration_s"] >= 0 for c in root["children"])
+    for i in range(5):
+        with t.span(f"r{i}"):
+            pass
+    assert len(t.recent()) == 3  # capacity bounds the buffer
+    assert t.recent()[0]["name"] == "r4"  # most recent first
+
+
+def test_span_error_recorded():
+    t = Tracer()
+    try:
+        with t.span("boom"):
+            raise ValueError("busted")
+    except ValueError:
+        pass
+    assert t.recent()[0]["error"] == "ValueError: busted"
+
+
+def test_annotate_attaches_to_open_span():
+    t = Tracer()
+    with t.span("load"):
+        t.annotate(hbm_bytes=42)
+    assert t.recent()[0]["attrs"]["hbm_bytes"] == 42
+
+
+def test_cross_thread_spans_join_via_copy_context():
+    """The serving pool runs JAX work in threads; copy_context (as
+    LocalServingBackend._run does) must parent those spans correctly."""
+    import contextvars
+
+    t = Tracer()
+    with t.span("request"):
+        ctx = contextvars.copy_context()
+
+        def work():
+            with t.span("thread_stage"):
+                pass
+
+        th = threading.Thread(target=lambda: ctx.run(work))
+        th.start()
+        th.join()
+    root = t.recent()[0]
+    assert [c["name"] for c in root["children"]] == ["thread_stage"]
+
+
+async def test_e2e_trace_through_rest(tmp_path):
+    """One REST predict produces one root trace with ensure/fetch/load/infer
+    stages under it, visible on /monitoring/traces."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.models.registry import export_artifact
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.rest import RestServingServer
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+    TRACER.clear()
+    export_artifact("half_plus_two", str(tmp_path / "store"), name="hpt", version=1)
+    manager = CacheManager(
+        DiskModelProvider(str(tmp_path / "store")),
+        ModelDiskCache(str(tmp_path / "cache"), 1 << 30),
+        TPUModelRuntime(ServingConfig(platform="cpu")),
+    )
+    backend = LocalServingBackend(manager)
+    rest = RestServingServer(backend, require_version=False)
+    port = await rest.start(0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{port}/v1/models/hpt/versions/1:predict",
+                data=json.dumps({"instances": [1.0, 3.0]}),
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+            async with s.get(f"http://127.0.0.1:{port}/monitoring/traces") as resp:
+                traces = (await resp.json())["traces"]
+    finally:
+        await rest.close()
+        backend.close()
+        manager.close()
+
+    rest_roots = [t for t in traces if t["name"] == "rest"]
+    assert rest_roots, traces
+    flat = json.dumps(rest_roots)
+    for stage in ("ensure_servable", "provider_fetch", "load", "infer"):
+        assert stage in flat, f"missing stage {stage}: {flat[:500]}"
+    # cold-path sanity: the fetch+load happened inside the rest request span
+    names = {c["name"] for c in rest_roots[-1].get("children", [])}
+    assert "ensure_servable" in names or "infer" in names

@@ -26,6 +26,7 @@ from tfservingcache_tpu.runtime.base import BaseRuntime
 from tfservingcache_tpu.types import Model, ModelId
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("cachemanager")
 
@@ -70,7 +71,8 @@ class CacheManager:
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
             return model
 
-        with self.disk_cache.fetch_lock(model_id):  # per-model singleflight
+        with TRACER.span("ensure_servable", model=str(model_id)), \
+                self.disk_cache.fetch_lock(model_id):  # per-model singleflight
             model = self.disk_cache.get(model_id)
             if model is not None:
                 if self.runtime.is_loaded(model_id):
@@ -96,11 +98,12 @@ class CacheManager:
         """MISS path: size -> evict-to-fit -> provider fetch -> index.
         Reference cachemanager.go:114-127 (minus its double-eviction quirk)."""
         t0 = time.monotonic()
-        size = self.provider.model_size(model_id.name, model_id.version)
-        self.disk_cache.ensure_free_bytes(size)
-        model = self.provider.load_model(
-            model_id.name, model_id.version, self.disk_cache.model_path(model_id)
-        )
+        with TRACER.span("provider_fetch", model=str(model_id)):
+            size = self.provider.model_size(model_id.name, model_id.version)
+            self.disk_cache.ensure_free_bytes(size)
+            model = self.provider.load_model(
+                model_id.name, model_id.version, self.disk_cache.model_path(model_id)
+            )
         self.disk_cache.put(model)
         if self.metrics is not None:
             self.metrics.cache_fetch_duration.labels(

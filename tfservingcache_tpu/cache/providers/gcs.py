@@ -31,6 +31,7 @@ _METADATA_TOKEN_URL = (
     "http://metadata.google.internal/computeMetadata/v1/instance/"
     "service-accounts/default/token"
 )
+_METADATA_RETRY_S = 60.0
 
 
 class GCSModelProvider(ObjectStoreProvider):
@@ -42,7 +43,10 @@ class GCSModelProvider(ObjectStoreProvider):
         self._base_url = (endpoint or "https://storage.googleapis.com").rstrip("/")
         self._token = ""
         self._token_expiry = 0.0
-        self._no_metadata = False  # negative-cache: off-GCP hosts stay anonymous
+        # negative-cache with TTL: off-GCP hosts stay anonymous without
+        # paying a metadata probe per request, but one transient failure on a
+        # real TPU-VM must not downgrade the provider to anonymous forever
+        self._no_metadata_until = 0.0
 
     # -- auth ----------------------------------------------------------------
     def _bearer_token(self) -> str:
@@ -51,7 +55,7 @@ class GCSModelProvider(ObjectStoreProvider):
             return env
         if self._token and time.monotonic() < self._token_expiry - 60:
             return self._token
-        if self._no_metadata:
+        if time.monotonic() < self._no_metadata_until:
             return ""
         req = urllib.request.Request(
             _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
@@ -59,13 +63,13 @@ class GCSModelProvider(ObjectStoreProvider):
         try:
             status, _, body = http_call(req, timeout=2.0, retries=1)
         except ProviderError:
-            self._no_metadata = True
-            return ""  # not on GCP: anonymous
+            self._no_metadata_until = time.monotonic() + _METADATA_RETRY_S
+            return ""  # not on GCP (or transient blip): anonymous for a while
         if status != 200:
             # negative-cache non-200 too (e.g. 404 when the instance has no
             # default service account): without it every list page and object
             # download would serially repeat the metadata round-trip
-            self._no_metadata = True
+            self._no_metadata_until = time.monotonic() + _METADATA_RETRY_S
             return ""
         tok = json.loads(body)
         self._token = tok.get("access_token", "")

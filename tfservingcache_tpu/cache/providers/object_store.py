@@ -70,7 +70,8 @@ def http_call(
                 return e.code, dict(e.headers.items()), body
         except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
             last_err = e
-        time.sleep(_RETRY_BACKOFF_S * (2**attempt))
+        if attempt + 1 < retries:  # no pointless backoff after the final attempt
+            time.sleep(_RETRY_BACKOFF_S * (2**attempt))
     raise ProviderError(f"object store unreachable after {retries} attempts: {last_err}")
 
 
@@ -99,7 +100,8 @@ def http_download(
                 raise ProviderError(f"download failed: HTTP {e.code}: {body!r}") from e
         except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
             last_err = e
-        time.sleep(_RETRY_BACKOFF_S * (2**attempt))
+        if attempt + 1 < retries:
+            time.sleep(_RETRY_BACKOFF_S * (2**attempt))
     raise ProviderError(f"download failed after {retries} attempts: {last_err}")
 
 

@@ -104,7 +104,10 @@ class EtcdDiscoveryService(DiscoveryService):
                 continue
             try:
                 await self._heartbeat_once(self_node.ident)
-            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError) as e:
+            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+                # ValueError covers a gateway answering 200 with a non-JSON
+                # body — must not kill the heartbeat task (lease would expire
+                # and drop a healthy node from every ring)
                 log.warning("etcd heartbeat failed: %s", e)
 
     # -- membership ---------------------------------------------------------

@@ -111,6 +111,9 @@ class MeshConfig:
 class MetricsConfig:
     model_labels: bool = False         # per-model:version labels (reference cachemanager.go:251-258)
     path: str = "/monitoring/prometheus/metrics"
+    # extra text-format exporters merged into this node's /metrics (reference
+    # MetricsHandler scraping TF Serving live, pkg/taskhandler/metrics.go:16-53)
+    scrape_targets: list[str] = field(default_factory=list)
 
 
 @dataclass

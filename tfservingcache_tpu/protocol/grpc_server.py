@@ -20,6 +20,7 @@ from tfservingcache_tpu.protocol.protos import grpc_health_pb2 as health_pb
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("grpc")
 
@@ -91,7 +92,8 @@ class GrpcServingServer:
             if self.metrics is not None:
                 self.metrics.request_count.labels("grpc").inc()
             try:
-                return await fn(request)
+                with TRACER.span("grpc", method=fn.__name__):
+                    return await fn(request)
             except BackendError as e:
                 if self.metrics is not None:
                     self.metrics.request_failures.labels("grpc").inc()

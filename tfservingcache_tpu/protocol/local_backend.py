@@ -13,6 +13,7 @@ loop keeps serving while the TPU is busy.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Mapping
@@ -60,7 +61,12 @@ class LocalServingBackend(ServingBackend):
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="tpusc-serve")
 
     async def _run(self, fn, *args):
-        return await asyncio.get_running_loop().run_in_executor(self._pool, fn, *args)
+        # copy_context: the executor job joins the request's ambient trace
+        # (utils.tracing) instead of starting an orphan root
+        ctx = contextvars.copy_context()
+        return await asyncio.get_running_loop().run_in_executor(
+            self._pool, lambda: ctx.run(fn, *args)
+        )
 
     # -- helpers ------------------------------------------------------------
     def _model_id(self, spec: sv.ModelSpec) -> ModelId:

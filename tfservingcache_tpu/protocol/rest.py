@@ -18,14 +18,17 @@ TF Serving, which no longer exists.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import re
+import threading
 
 from aiohttp import web
 
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("rest")
 
@@ -70,6 +73,7 @@ class RestServingServer:
         require_version: bool = True,
         metrics_path: str | None = None,
         max_body_bytes: int = 256 << 20,
+        metrics_scrape_targets: list[str] | None = None,
     ) -> None:
         self.backend = backend
         self.metrics = metrics
@@ -77,17 +81,34 @@ class RestServingServer:
         # on the cache node the router always sends versioned URLs.
         self.require_version = require_version
         self.metrics_path = metrics_path
+        # extra text-format exporters folded into /metrics (reference
+        # MetricsHandler scrape-merge, pkg/taskhandler/metrics.go:16-53)
+        self.metrics_scrape_targets = metrics_scrape_targets or []
         self.app = web.Application(client_max_size=max_body_bytes)
         self.app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
+        self._profile_lock = threading.Lock()  # one JAX profile capture at a time
 
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
         path = request.path
         if self.metrics_path and path == self.metrics_path and self.metrics is not None:
-            return web.Response(body=self.metrics.render(), content_type="text/plain")
+            body = self.metrics.render()
+            if self.metrics_scrape_targets:
+                from tfservingcache_tpu.utils.metrics import scrape_and_merge
+
+                body = await scrape_and_merge(body, self.metrics_scrape_targets)
+            return web.Response(body=body, content_type="text/plain")
         if path == "/healthz":
             return web.json_response({"status": "ok"})
+        if path == "/monitoring/traces":
+            try:
+                n = int(request.query.get("n", "50"))
+            except ValueError:
+                return web.json_response({"error": "n must be an integer"}, status=400)
+            return web.json_response({"traces": TRACER.recent(n)})
+        if path == "/monitoring/profiler" and request.method == "POST":
+            return await self._capture_profile(request)
 
         if self.metrics is not None:
             self.metrics.request_count.labels("rest").inc()
@@ -106,9 +127,10 @@ class RestServingServer:
             ))
         body = await request.read()
         try:
-            resp: RestResponse = await self.backend.handle_rest(
-                request.method, name, version, verb, body
-            )
+            with TRACER.span("rest", path=path, method=request.method):
+                resp: RestResponse = await self.backend.handle_rest(
+                    request.method, name, version, verb, body
+                )
         except BackendError as e:
             return self._fail(web.Response(
                 status=e.http_status,
@@ -130,6 +152,33 @@ class RestServingServer:
             content_type=resp.content_type,
             headers=resp.headers,
         )
+
+    async def _capture_profile(self, request: web.Request) -> web.Response:
+        """Capture a JAX/XLA device profile for ``duration_s`` into ``dir``
+        (TensorBoard-loadable). The reference exposes nothing comparable
+        (SURVEY.md §5 tracing: none)."""
+        try:
+            duration_s = min(float(request.query.get("duration_s", "2")), 60.0)
+        except ValueError:
+            return web.json_response({"error": "duration_s must be a number"}, status=400)
+        log_dir = request.query.get("dir", "/tmp/tpusc_profile")
+        if not self._profile_lock.acquire(blocking=False):
+            return web.json_response({"error": "profile capture in progress"}, status=409)
+        try:
+            import jax
+
+            jax.profiler.start_trace(log_dir)
+            try:
+                await asyncio.sleep(duration_s)
+            finally:
+                # stop even on client-disconnect cancellation: a dangling
+                # global profiler would fail every future start_trace
+                jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"error": f"{type(e).__name__}: {e}"}, status=500)
+        finally:
+            self._profile_lock.release()
+        return web.json_response({"status": "ok", "dir": log_dir, "duration_s": duration_s})
 
     def _fail(self, response: web.Response) -> web.Response:
         if self.metrics is not None:

@@ -34,6 +34,7 @@ from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, Ru
 from tfservingcache_tpu.types import Model, ModelId, ModelState
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("runtime")
 
@@ -114,6 +115,12 @@ class TPUModelRuntime(BaseRuntime):
         mid = model.identifier
         self._set_state(mid, ModelState.START)
         t0 = time.monotonic()
+        with TRACER.span("load", model=str(mid)):
+            self._load_traced(model, mid, t0)
+
+    def _load_traced(self, model: Model, mid: ModelId, t0: float) -> None:
+        import jax
+
         try:
             self._set_state(mid, ModelState.LOADING)
             model_def, host_params = load_artifact(model.path)
@@ -140,8 +147,10 @@ class TPUModelRuntime(BaseRuntime):
             try:
                 hbm = tree_nbytes(params)
                 loaded = LoadedModel(model_def, params, jitted, hbm)
+                TRACER.annotate(hbm_bytes=hbm, shared_executable=not created)
                 if self.cfg.warmup:
-                    self._warmup(loaded)  # compile happens here, outside the lock
+                    with TRACER.span("compile_warmup", family=model_def.family):
+                        self._warmup(loaded)  # compile happens here, outside the lock
                 with self._jit_lock:
                     # increment + insert atomically w.r.t. evictions: an
                     # eviction of a same-family sibling between put and
@@ -212,8 +221,9 @@ class TPUModelRuntime(BaseRuntime):
             raise RuntimeError_(f"unknown inputs {sorted(unknown)} for {model_id}")
 
         dyn_sizes, padded = self._pad_to_bucket(spec, inputs, loaded.model_def.axis_caps)
-        out = loaded.jitted(loaded.params, padded)
-        out = jax.device_get(out)
+        with TRACER.span("infer", model=str(model_id)):
+            out = loaded.jitted(loaded.params, padded)
+            out = jax.device_get(out)
         out_spec = loaded.model_def.output_spec
         result: dict[str, np.ndarray] = {}
         for name, arr in out.items():

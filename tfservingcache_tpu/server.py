@@ -56,6 +56,7 @@ class CacheNode:
             self.metrics,
             require_version=False,
             metrics_path=cfg.metrics.path,
+            metrics_scrape_targets=cfg.metrics.scrape_targets,
         )
         self.grpc = GrpcServingServer(
             self.backend, self.metrics, cfg.proxy.grpc_max_message_bytes

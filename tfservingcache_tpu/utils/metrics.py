@@ -9,6 +9,8 @@ reference counterpart. Per-model labels are optional to bound cardinality
 
 from __future__ import annotations
 
+import asyncio
+
 from prometheus_client import (
     CollectorRegistry,
     Counter,
@@ -95,3 +97,81 @@ class Metrics:
         reference merges TF Serving's scrape here too — metrics.go:16-53 —
         which disappears now that serving is in-process)."""
         return generate_latest(self.registry)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _emit_families(families, skip: set[str]) -> tuple[list[str], set[str]]:
+    """Re-emit parsed metric families as exposition text, skipping family
+    names already emitted (cross-exporter duplicates like python_gc_* would
+    otherwise make Prometheus reject the whole scrape)."""
+    out: list[str] = []
+    emitted: set[str] = set()
+    for fam in families:
+        if fam.name in skip:
+            continue
+        emitted.add(fam.name)
+        out.append(f"# HELP {fam.name} {fam.documentation}")
+        out.append(f"# TYPE {fam.name} {fam.type}")
+        for s in fam.samples:
+            labels = ",".join(
+                f'{k}="{_escape_label(str(v))}"' for k, v in sorted(s.labels.items())
+            )
+            label_part = f"{{{labels}}}" if labels else ""
+            out.append(f"{s.name}{label_part} {s.value}")
+    return out, emitted
+
+
+async def scrape_and_merge(own: bytes, targets: list[str], timeout_s: float = 2.0) -> bytes:
+    """Merge externally-scraped text-format metrics into one exposition.
+
+    Reference equivalent: MetricsHandler's live scrape of TF Serving's
+    metrics endpoint merged with the process's own registry
+    (pkg/taskhandler/metrics.go:16-53). Serving moved in-process, but the
+    same trick folds sidecar exporters (e.g. libtpu / node exporters) into
+    this node's single /metrics endpoint. Targets are fetched concurrently
+    (a down sidecar costs one timeout, not one per target), each body is
+    parsed and re-emitted with cross-exporter duplicate families dropped
+    (own registry wins), and unreachable/corrupt targets are skipped."""
+    if not targets:
+        return own
+    import logging
+
+    import aiohttp
+    from prometheus_client.parser import text_string_to_metric_families
+
+    async def fetch(session: aiohttp.ClientSession, url: str) -> str | None:
+        try:
+            async with session.get(url) as resp:
+                if resp.status != 200:
+                    raise ValueError(f"HTTP {resp.status}")
+                return await resp.text()
+        except Exception as e:  # noqa: BLE001 — degraded scrape is non-fatal
+            logging.getLogger("tpusc.metrics").warning(
+                "metrics scrape of %s failed: %s", url, e
+            )
+            return None
+
+    async with aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=timeout_s)
+    ) as session:
+        bodies = await asyncio.gather(*(fetch(session, url) for url in targets))
+
+    seen = {f.name for f in text_string_to_metric_families(own.decode())}
+    parts = [own.rstrip(b"\n")]
+    for url, body in zip(targets, bodies):
+        if body is None:
+            continue
+        try:
+            lines, emitted = _emit_families(text_string_to_metric_families(body), seen)
+        except ValueError as e:
+            logging.getLogger("tpusc.metrics").warning(
+                "metrics scrape of %s unparseable: %s", url, e
+            )
+            continue
+        seen |= emitted
+        if lines:
+            parts.append("\n".join(lines).encode())
+    return b"\n".join(parts) + b"\n"

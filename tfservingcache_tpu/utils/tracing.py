@@ -1,0 +1,103 @@
+"""Per-request, per-stage tracing.
+
+The reference has no tracing at all (SURVEY.md §5: "no OpenTelemetry/pprof
+anywhere"; latency visibility is two Prometheus histograms) — this is
+greenfield. Design: a process-wide ring buffer of completed request traces,
+each a tree of spans (route -> ensure -> fetch/compile -> infer), ambient
+via contextvars so call sites never thread a handle. Cross-thread hops
+(the serving pool running JAX work) join the request's trace because
+LocalServingBackend runs executor jobs under ``contextvars.copy_context``.
+
+Overhead when idle: one contextvar lookup + two ``monotonic()`` calls per
+span — cheap enough to leave always-on; the buffer bounds memory.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "tpusc_current_span", default=None
+)
+
+
+@dataclass
+class Span:
+    name: str
+    attrs: dict[str, Any]
+    start_s: float                      # wall-clock epoch (for display)
+    t0: float = 0.0                     # monotonic (for duration)
+    duration_s: float = 0.0
+    error: str = ""
+    children: list["Span"] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "duration_s": round(self.duration_s, 6),
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.error:
+            d["error"] = self.error
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+
+class Tracer:
+    def __init__(self, capacity: int = 256) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._traces: list[Span] = []
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
+        """Open a span under the ambient parent; a span with no parent is a
+        root trace and lands in the ring buffer on completion."""
+        sp = Span(name=name, attrs=attrs, start_s=time.time(), t0=time.monotonic())
+        parent = _current_span.get()
+        token = _current_span.set(sp)
+        try:
+            yield sp
+        except BaseException as e:
+            sp.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            sp.duration_s = time.monotonic() - sp.t0
+            _current_span.reset(token)
+            if parent is not None:
+                # list.append is atomic under the GIL; concurrent child spans
+                # of one request (gather'd ensures) interleave safely
+                parent.children.append(sp)
+            else:
+                with self._lock:
+                    self._traces.append(sp)
+                    if len(self._traces) > self.capacity:
+                        del self._traces[: len(self._traces) - self.capacity]
+
+    def annotate(self, **attrs: Any) -> None:
+        """Attach attributes to the innermost open span, if any."""
+        sp = _current_span.get()
+        if sp is not None:
+            sp.attrs.update(attrs)
+
+    def recent(self, n: int = 50) -> list[dict[str, Any]]:
+        with self._lock:
+            return [s.to_dict() for s in self._traces[-n:]][::-1]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+# Process-wide default. Diagnostics are write-mostly and bounded, so a global
+# (unlike Metrics, which stays injected for registry isolation) keeps every
+# call site plumbing-free; tests snapshot/clear it.
+TRACER = Tracer()

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Manual gRPC smoke-test client against a proxy or cache node.
+
+Reference equivalent: cmd/testclient/main.go (C18 in SURVEY.md §2) — a
+hand-run Classify against the proxy port. Extended with Predict / status /
+metadata verbs since those are the hot paths here.
+
+Examples:
+    python tools/testclient.py --target localhost:8100 --model m1 --version 1 \
+        --predict '{"x": [[1.0, 2.0]]}'
+    python tools/testclient.py --target localhost:8100 --model m1 --classify
+    python tools/testclient.py --target localhost:8095 --model m1 --status
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+import numpy as np
+
+from tfservingcache_tpu.protocol import codec
+from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
+from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
+from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+
+PREDICTION = "tensorflow.serving.PredictionService"
+MODEL = "tensorflow.serving.ModelService"
+
+
+def model_spec(name: str, version: int | None) -> sv.ModelSpec:
+    spec = sv.ModelSpec(name=name)
+    if version is not None:
+        spec.version.value = version
+    return spec
+
+
+async def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--target", default="localhost:8100")
+    p.add_argument("--model", required=True)
+    p.add_argument("--version", type=int, default=None)
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--predict", metavar="JSON", help='inputs, e.g. \'{"x": [[1.0]]}\'')
+    g.add_argument("--classify", action="store_true", help="empty-example Classify (reference testclient flow)")
+    g.add_argument("--status", action="store_true", help="ModelService.GetModelStatus")
+    g.add_argument("--metadata", action="store_true")
+    args = p.parse_args()
+
+    channel = make_channel(args.target)
+    stub = ServingStub(channel)
+    spec = model_spec(args.model, args.version)
+    try:
+        if args.predict:
+            req = sv.PredictRequest(model_spec=spec)
+            for name, value in json.loads(args.predict).items():
+                req.inputs[name].CopyFrom(codec.numpy_to_tensorproto(np.asarray(value)))
+            resp = await stub.method(PREDICTION, "Predict")(req, timeout=30)
+            out = {k: codec.tensorproto_to_numpy(v).tolist() for k, v in resp.outputs.items()}
+            print(json.dumps({"outputs": out}))
+        elif args.classify:
+            # one empty Example, like the reference's manual smoke flow
+            # (cmd/testclient/main.go:20-36)
+            req = sv.ClassificationRequest(
+                model_spec=spec,
+                input=sv.Input(example_list=sv.ExampleList(examples=[core.Example()])),
+            )
+            resp = await stub.method(PREDICTION, "Classify")(req, timeout=30)
+            print(resp)
+        elif args.status:
+            req = sv.GetModelStatusRequest(model_spec=spec)
+            resp = await stub.method(MODEL, "GetModelStatus")(req, timeout=10)
+            print(resp)
+        else:
+            req = sv.GetModelMetadataRequest(model_spec=spec, metadata_field=["signature_def"])
+            resp = await stub.method(PREDICTION, "GetModelMetadata")(req, timeout=10)
+            print(resp)
+    finally:
+        await channel.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))
