@@ -1,0 +1,190 @@
+"""Native (C++) runtime component tests.
+
+The native ring/LRU must be observably identical to the Python fallbacks —
+a mixed fleet (some nodes with the .so built, some without) has to agree on
+every placement decision, and either tier implementation must satisfy the
+reference LRU semantics (pkg/cachemanager/lrucache_test.go scenarios, run
+against the native class here and against the Python class in test_lru.py).
+"""
+
+import hashlib
+import random
+import string
+
+import pytest
+
+from tfservingcache_tpu import native
+from tfservingcache_tpu.cache.lru import CapacityError, LRUCache
+from tfservingcache_tpu.cluster.hashring import HashRing
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native library unavailable (no toolchain)"
+)
+
+
+def test_blake2b64_matches_hashlib():
+    rnd = random.Random(7)
+    cases = [b"", b"a", b"x" * 127, b"x" * 128, b"x" * 129, b"y" * 4096]
+    cases += [
+        "".join(rnd.choices(string.printable, k=rnd.randint(0, 500))).encode()
+        for _ in range(300)
+    ]
+    for data in cases:
+        expect = int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+        assert native.blake2b64(data) == expect
+
+
+def test_ring_placement_parity_fuzz():
+    """Every (membership, key, n) must map to the same owners in both rings."""
+    rnd = random.Random(42)
+    py, cc = HashRing(vnodes=80), native.NativeHashRing(vnodes=80)
+    pool = [f"10.{i}.{j}.{k}:8094:8095" for i in range(2) for j in range(4) for k in range(8)]
+    for _ in range(12):
+        members = rnd.sample(pool, rnd.randint(0, len(pool)))
+        py.set_members(members)
+        cc.set_members(members)
+        assert py.members == cc.members
+        assert len(py) == len(cc)
+        for _ in range(120):
+            key = f"tenant{rnd.randint(0, 9999)}##{rnd.randint(1, 4)}"
+            n = rnd.randint(1, 6)
+            assert py.get_n(key, n) == cc.get_n(key, n)
+            assert py.get(key) == cc.get(key)
+
+
+def test_ring_empty_and_degenerate():
+    cc = native.NativeHashRing()
+    assert cc.get_n("k", 3) == []
+    assert cc.get("k") is None
+    cc.set_members(["solo:1:2"])
+    assert cc.get_n("k", 5) == ["solo:1:2"]  # n clamped to member count
+
+
+def test_ring_long_member_names_grow_buffer():
+    cc = native.NativeHashRing(vnodes=16)
+    members = [f"{'h' * 500}{i}:8094:8095" for i in range(20)]
+    cc.set_members(members)
+    got = cc.get_n("key", 20)
+    assert sorted(got) == sorted(members)
+
+
+# ---------------------------------------------------------------------------
+# Native LRU: reference-scenario tests (mirror of test_lru.py) + parity fuzz
+# ---------------------------------------------------------------------------
+
+
+def test_lru_sequential_eviction_order():
+    evicted = []
+    c = native.NativeLRUCache(30, on_evict=lambda k, e: evicted.append(k))
+    for i in range(3):
+        c.put(f"m{i}", 10, i)
+    c.put("m3", 10, 3)
+    c.put("m4", 10, 4)
+    assert evicted == ["m0", "m1"]
+    assert c.keys_mru_first() == ["m4", "m3", "m2"]
+    assert c.total_bytes == 30
+
+
+def test_lru_touch_changes_eviction_order():
+    evicted = []
+    c = native.NativeLRUCache(30, on_evict=lambda k, e: evicted.append(k))
+    for i in range(3):
+        c.put(f"m{i}", 10, i)
+    assert c.get("m0") == 0  # m0 becomes MRU
+    c.put("m3", 10, 3)
+    assert evicted == ["m1"]
+
+
+def test_lru_variable_size_and_ensure_free():
+    c = native.NativeLRUCache(100)
+    c.put("a", 60, "A")
+    c.put("b", 30, "B")
+    freed = c.ensure_free_bytes(50)
+    assert freed == ["a"]
+    assert c.total_bytes == 30
+    with pytest.raises(CapacityError):
+        c.ensure_free_bytes(101)
+    with pytest.raises(CapacityError):
+        c.put("huge", 101, None)
+
+
+def test_lru_replace_runs_callback_and_reaccounts():
+    evicted = []
+    c = native.NativeLRUCache(100, on_evict=lambda k, e: evicted.append((k, e.size_bytes)))
+    c.put("a", 10, "v1")
+    out = c.put("a", 30, "v2")
+    assert out == []  # replaced key not reported as evicted
+    assert evicted == [("a", 10)]  # old entry's resources released
+    assert c.total_bytes == 30
+    assert c.get("a") == "v2"
+
+
+def test_lru_max_items():
+    c = native.NativeLRUCache(10_000, max_items=2)
+    c.put("a", 1, "A")
+    c.put("b", 1, "B")
+    out = c.put("c", 1, "C")
+    assert out == ["a"]
+    assert len(c) == 2
+
+
+def test_lru_remove_and_clear():
+    evicted = []
+    c = native.NativeLRUCache(100, on_evict=lambda k, e: evicted.append(k))
+    c.put("a", 10, "A")
+    c.put("b", 10, "B")
+    assert c.remove("a") == "A"
+    assert evicted == []  # remove without callback by default
+    assert c.remove("nope") is None
+    c.clear()
+    assert evicted == ["b"]
+    assert len(c) == 0 and c.total_bytes == 0
+
+
+def test_lru_parity_fuzz_vs_python():
+    """Random op-sequence applied to both implementations; all observable
+    state (evictions, order, byte totals, hits) must stay identical."""
+    rnd = random.Random(3)
+    ev_py, ev_cc = [], []
+    py = LRUCache(200, on_evict=lambda k, e: ev_py.append((k, e.size_bytes)), max_items=12)
+    cc = native.NativeLRUCache(
+        200, on_evict=lambda k, e: ev_cc.append((k, e.size_bytes)), max_items=12
+    )
+    keys = [f"m{i}" for i in range(30)]
+    for step in range(800):
+        op = rnd.random()
+        k = rnd.choice(keys)
+        if op < 0.5:
+            size = rnd.randint(1, 60)
+            if size > 200:
+                continue
+            assert py.put(k, size, step) == cc.put(k, size, step), step
+        elif op < 0.75:
+            touch = rnd.random() < 0.8
+            assert py.get(k, touch=touch) == cc.get(k, touch=touch), step
+        elif op < 0.9:
+            assert py.remove(k) == cc.remove(k)
+        else:
+            n = rnd.randint(0, 150)
+            assert py.ensure_free_bytes(n) == cc.ensure_free_bytes(n)
+        assert py.total_bytes == cc.total_bytes, step
+        assert len(py) == len(cc), step
+        assert py.keys_mru_first() == cc.keys_mru_first(), step
+    assert ev_py == ev_cc
+
+
+def test_unrepresentable_keys_rejected():
+    c = native.NativeLRUCache(100)
+    for bad in ("a\nb", "", "nul\x00key"):
+        with pytest.raises(ValueError):
+            c.put(bad, 1, None)
+    r = native.NativeHashRing()
+    with pytest.raises(ValueError):
+        r.set_members(["ok:1:2", "bad\nhost:1:2"])
+
+
+def test_factories_pick_native():
+    ring = native.make_ring()
+    lru = native.make_lru_cache(100)
+    assert isinstance(ring, native.NativeHashRing)
+    assert isinstance(lru, native.NativeLRUCache)

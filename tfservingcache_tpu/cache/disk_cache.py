@@ -19,7 +19,8 @@ import threading
 from contextlib import contextmanager
 from typing import Callable, Iterator
 
-from tfservingcache_tpu.cache.lru import LRUCache, LRUEntry
+from tfservingcache_tpu.cache.lru import LRUEntry
+from tfservingcache_tpu.native import make_lru_cache
 from tfservingcache_tpu.types import Model, ModelId
 from tfservingcache_tpu.utils.logging import get_logger
 
@@ -51,7 +52,7 @@ class ModelDiskCache:
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
         self._user_on_evict = on_evict
-        self.lru: LRUCache[ModelId, Model] = LRUCache(capacity_bytes, self._evict)
+        self.lru = make_lru_cache(capacity_bytes, self._evict)
         # Per-model mutexes shared by eviction and (re)load: a deferred evict
         # rmtree must not race a concurrent re-fetch writing the same path.
         self._key_locks: dict[ModelId, threading.Lock] = {}

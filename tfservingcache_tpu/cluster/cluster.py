@@ -12,7 +12,7 @@ import random
 from typing import Callable
 
 from tfservingcache_tpu.cluster.discovery.base import DiscoveryService
-from tfservingcache_tpu.cluster.hashring import HashRing
+from tfservingcache_tpu.native import make_ring
 from tfservingcache_tpu.types import NodeInfo
 from tfservingcache_tpu.utils.logging import get_logger
 
@@ -28,7 +28,7 @@ class ClusterConnection:
     ) -> None:
         self.discovery = discovery
         self.replicas_per_model = replicas_per_model
-        self.ring = HashRing(vnodes=vnodes)
+        self.ring = make_ring(vnodes=vnodes)  # C++ ring when built, Python fallback
         self._nodes_by_ident: dict[str, NodeInfo] = {}
         self._task: asyncio.Task | None = None
         self._first_update = asyncio.Event()

@@ -27,7 +27,8 @@ from typing import Any, Mapping
 
 import numpy as np
 
-from tfservingcache_tpu.cache.lru import LRUCache, LRUEntry
+from tfservingcache_tpu.cache.lru import LRUEntry
+from tfservingcache_tpu.native import make_lru_cache
 from tfservingcache_tpu.config import ServingConfig
 from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, load_artifact
 from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
@@ -80,7 +81,7 @@ class TPUModelRuntime(BaseRuntime):
             jax.config.update("jax_compilation_cache_dir", self.cfg.compile_cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         self._devices = jax.devices(self.cfg.platform or None)
-        self._resident: LRUCache[ModelId, LoadedModel] = LRUCache(
+        self._resident = make_lru_cache(
             self.cfg.hbm_capacity_bytes,
             on_evict=self._on_evict,
             max_items=self.cfg.max_concurrent_models,
