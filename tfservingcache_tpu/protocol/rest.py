@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import re
 import threading
 
@@ -89,6 +90,9 @@ class RestServingServer:
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
         self._profile_lock = threading.Lock()  # one JAX profile capture at a time
+        self.profiler_base_dir = os.environ.get(
+            "TPUSC_PROFILER_DIR", "/tmp/tpusc_profile"
+        )
 
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
         path = request.path
@@ -106,7 +110,9 @@ class RestServingServer:
                 n = int(request.query.get("n", "50"))
             except ValueError:
                 return web.json_response({"error": "n must be an integer"}, status=400)
-            return web.json_response({"traces": TRACER.recent(n)})
+            # n<=0 means "none", not "everything" (negative slices would
+            # truncate from the wrong end of the ring buffer)
+            return web.json_response({"traces": TRACER.recent(n) if n > 0 else []})
         if path == "/monitoring/profiler" and request.method == "POST":
             return await self._capture_profile(request)
 
@@ -161,7 +167,16 @@ class RestServingServer:
             duration_s = min(float(request.query.get("duration_s", "2")), 60.0)
         except ValueError:
             return web.json_response({"error": "duration_s must be a number"}, status=400)
-        log_dir = request.query.get("dir", "/tmp/tpusc_profile")
+        # Captures are confined under a fixed base dir; the client picks only
+        # a simple label — never a path — so the unauthenticated serving port
+        # can't be used to write profile trees to arbitrary locations.
+        label = request.query.get("label", "default")
+        if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", label) or label.startswith("."):
+            return web.json_response(
+                {"error": "label must be [A-Za-z0-9._-]{1,64} and not start with '.'"},
+                status=400,
+            )
+        log_dir = os.path.join(self.profiler_base_dir, label)
         if not self._profile_lock.acquire(blocking=False):
             return web.json_response({"error": "profile capture in progress"}, status=409)
         try:

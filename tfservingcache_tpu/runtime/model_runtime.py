@@ -111,8 +111,6 @@ class TPUModelRuntime(BaseRuntime):
             self._load(model)
 
     def _load(self, model: Model) -> None:
-        import jax
-
         mid = model.identifier
         self._set_state(mid, ModelState.START)
         t0 = time.monotonic()

@@ -103,6 +103,12 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    # exposition-format HELP escaping: backslash and newline only — the
+    # parser unescaped these, so re-emitting raw would corrupt the merge
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _emit_families(families, skip: set[str]) -> tuple[list[str], set[str]]:
     """Re-emit parsed metric families as exposition text, skipping family
     names already emitted (cross-exporter duplicates like python_gc_* would
@@ -113,7 +119,7 @@ def _emit_families(families, skip: set[str]) -> tuple[list[str], set[str]]:
         if fam.name in skip:
             continue
         emitted.add(fam.name)
-        out.append(f"# HELP {fam.name} {fam.documentation}")
+        out.append(f"# HELP {fam.name} {_escape_help(fam.documentation)}")
         out.append(f"# TYPE {fam.name} {fam.type}")
         for s in fam.samples:
             labels = ",".join(
