@@ -51,6 +51,12 @@ CASES = {
         {"labels": np.array([[1, 2, 3]], np.int32)},
         None,
     ),
+    "moe_lm": (
+        {**LM_TINY, "n_experts": 4, "capacity_factor": 2.0},
+        {"input_ids": np.array([[1, 2, 3, 4]], np.int32)},
+        {"labels": np.array([[1, 2, 3, 4]], np.int32)},
+        None,
+    ),
 }
 
 

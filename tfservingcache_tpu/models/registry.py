@@ -128,7 +128,9 @@ def build(family: str, config: dict[str, Any] | None = None) -> ModelDef:
     return model
 
 
-_BUILTIN_MODULES = ("half_plus_two", "mnist_cnn", "bert", "resnet", "transformer_lm", "t5")
+_BUILTIN_MODULES = (
+    "half_plus_two", "mnist_cnn", "bert", "resnet", "transformer_lm", "t5", "moe_lm",
+)
 
 
 def _load_builtin_families() -> None:

@@ -78,13 +78,11 @@ def ring_attention(
 ) -> jax.Array:
     """(B, H, S, D) attention with S sharded over ``mesh[axis]``. The full
     sequence never resides on one chip."""
-    from jax.experimental.shard_map import shard_map
-
     n_shards = mesh.shape[axis]
     if q.shape[2] % n_shards:
         raise ValueError(f"sequence {q.shape[2]} not divisible by {n_shards} ring shards")
     spec = P(None, None, axis, None)
-    fn = shard_map(
+    fn = jax.shard_map(
         functools.partial(_ring_shard_fn, axis=axis, n_shards=n_shards, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
