@@ -103,7 +103,9 @@ def pipeline_apply(
         raise ValueError(
             f"stage_params leading dim(s) {sorted(leading)} != {n_stages} mesh stages"
         )
-    n_micro = n_microbatches or n_stages
+    n_micro = n_stages if n_microbatches is None else n_microbatches
+    if n_micro < 1:
+        raise ValueError(f"n_microbatches must be >= 1, got {n_micro}")
     if x.shape[0] % n_micro:
         raise ValueError(f"batch {x.shape[0]} not divisible into {n_micro} microbatches")
     mb = x.shape[0] // n_micro
