@@ -1,0 +1,194 @@
+"""MicroBatcher: coalescing, scatter correctness, flush triggers, errors.
+
+The reference gets batching for free from TF Serving's --enable_batching;
+in-process serving needs its own (runtime/batcher.py). Tests use the
+FakeRuntime (x -> x*version + bias) so per-caller results are checkable
+after scatter.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.models.registry import TensorSpec
+from tfservingcache_tpu.runtime.batcher import MicroBatcher
+from tfservingcache_tpu.runtime.fake import FakeRuntime
+from tfservingcache_tpu.types import Model, ModelId
+
+
+def make_runtime(delay_s: float = 0.0) -> FakeRuntime:
+    rt = FakeRuntime()
+    if delay_s:
+        orig = rt.predict
+
+        def slow(*a, **kw):
+            time.sleep(delay_s)
+            return orig(*a, **kw)
+
+        rt.predict = slow
+    return rt
+
+
+def load(rt, name="m", version=1) -> ModelId:
+    mid = ModelId(name, version)
+    rt.ensure_loaded(Model(identifier=mid, path="/nowhere"))
+    return mid
+
+
+def test_concurrent_requests_coalesce_into_fewer_device_calls():
+    rt = make_runtime(delay_s=0.05)
+    mid = load(rt)
+    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+
+    def one(i):
+        x = np.array([float(i)], np.float32)
+        out = b.predict(mid, {"x": x})
+        return float(out["y"][0])
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        results = list(pool.map(one, range(8)))
+
+    assert results == [float(i) for i in range(8)]  # version=1, bias=0
+    assert b.batches >= 1 and b.batched_requests >= 2
+    # strictly fewer device calls than requests
+    assert len(rt.predicts) < 8
+
+
+def test_scatter_respects_row_counts_and_order():
+    rt = make_runtime(delay_s=0.05)
+    mid = load(rt, version=3)
+    b = MicroBatcher(rt, window_ms=50.0, max_batch=64)
+    sizes = [1, 3, 2]
+
+    def one(k):
+        rows = sizes[k]
+        x = np.full((rows,), 10.0 * k, np.float32)
+        out = b.predict(mid, {"x": x})
+        assert out["y"].shape == (rows,)
+        return out["y"]
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        outs = list(pool.map(one, range(3)))
+    for k, y in enumerate(outs):
+        np.testing.assert_allclose(y, np.full((sizes[k],), 30.0 * k))
+
+
+def test_max_batch_flushes_early():
+    rt = make_runtime(delay_s=0.02)
+    mid = load(rt)
+    b = MicroBatcher(rt, window_ms=10_000.0, max_batch=4)  # window never expires
+
+    def one(i):
+        return b.predict(mid, {"x": np.array([float(i)], np.float32)})["y"][0]
+
+    t0 = time.monotonic()
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        results = sorted(pool.map(one, range(4)))
+    took = time.monotonic() - t0
+    assert took < 5.0, "max_batch flush did not cut the window short"
+    assert results == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_different_models_do_not_mix():
+    rt = make_runtime(delay_s=0.05)
+    m1, m2 = load(rt, "a", 1), load(rt, "b", 2)
+    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+
+    def one(mid, v):
+        return float(b.predict(mid, {"x": np.array([v], np.float32)})["y"][0])
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        f1 = pool.submit(one, m1, 5.0)
+        f2 = pool.submit(one, m2, 5.0)
+        assert f1.result() == 5.0   # version 1
+        assert f2.result() == 10.0  # version 2
+
+
+def test_error_propagates_to_all_waiters():
+    rt = make_runtime()
+    mid = load(rt)
+
+    def boom(*a, **kw):
+        time.sleep(0.05)
+        raise RuntimeError("device on fire")
+
+    rt.predict = boom
+    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+
+    def one(i):
+        b.predict(mid, {"x": np.array([float(i)], np.float32)})
+
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        futs = [pool.submit(one, i) for i in range(4)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="device on fire"):
+                f.result()
+
+
+def test_model_without_batch_axis_falls_through():
+    rt = make_runtime()
+    mid = load(rt)
+    rt.signature = lambda m: (
+        {"x": TensorSpec("float32", (4,))},   # fully static: no "batch" axis
+        {"y": TensorSpec("float32", (4,))},
+        "tensorflow/serving/predict",
+    )
+    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    out = b.predict(mid, {"x": np.ones((4,), np.float32)})
+    np.testing.assert_allclose(out["y"], np.ones(4))
+    assert b.batches == 0  # passthrough, not batched
+
+
+def test_batch_reducing_output_falls_through():
+    # an output with no batch axis is reduced over the batch: coalescing
+    # would mix callers' rows into it, so the model must run solo
+    rt = make_runtime()
+    mid = load(rt)
+    rt.signature = lambda m: (
+        {"x": TensorSpec("float32", ("batch",))},
+        {"y": TensorSpec("float32", ())},   # scalar aggregate
+        "tensorflow/serving/predict",
+    )
+    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    out = b.predict(mid, {"x": np.ones((2,), np.float32)})
+    assert "y" in out
+    assert b.batches == 0
+
+
+def test_max_batch_is_a_hard_cap():
+    rt = make_runtime(delay_s=0.05)
+    mid = load(rt)
+    seen_sizes = []
+    orig = rt.predict
+
+    def record(m, inputs, f=None):
+        seen_sizes.append(int(np.asarray(inputs["x"]).shape[0]))
+        return orig(m, inputs, f)
+
+    rt.predict = record
+    b = MicroBatcher(rt, window_ms=60.0, max_batch=8)
+
+    def one(rows, base):
+        x = np.full((rows,), base, np.float32)
+        return b.predict(mid, {"x": x})["y"]
+
+    with ThreadPoolExecutor(max_workers=6) as pool:
+        futs = [pool.submit(one, r, float(i)) for i, r in enumerate([3, 3, 3, 3, 9, 2])]
+        outs = [f.result() for f in futs]
+    assert all(s <= 9 for s in seen_sizes)      # 9-row solo allowed, no join
+    joined = [s for s in seen_sizes if s != 9]
+    assert all(s <= 8 for s in joined), seen_sizes  # coalesced calls capped
+    for i, r in enumerate([3, 3, 3, 3, 9, 2]):
+        np.testing.assert_allclose(outs[i], np.full((r,), float(i)))
+
+
+def test_single_request_runs_solo_without_batch_overhead():
+    rt = make_runtime()
+    mid = load(rt)
+    b = MicroBatcher(rt, window_ms=5.0, max_batch=64)
+    out = b.predict(mid, {"x": np.array([2.0], np.float32)})
+    assert float(out["y"][0]) == 2.0
+    assert b.batches == 0  # solo leader path

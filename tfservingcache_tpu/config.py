@@ -35,6 +35,11 @@ class ServingConfig:
     load_timeout_s: float = 30.0           # cold-load deadline (reference: 10s, main.go:122)
     platform: str = ""                     # "" = default jax backend; "cpu" forces CPU
     donate_on_evict: bool = True
+    # adaptive micro-batching (TF Serving --enable_batching equivalent,
+    # in-process now): 0 disables; concurrent same-shape requests within the
+    # window coalesce into one device call
+    batch_window_ms: float = 0.0
+    batch_max_size: int = 64
 
 
 @dataclass

@@ -54,11 +54,25 @@ _NP_TO_DT_NAME = {
 
 
 class LocalServingBackend(ServingBackend):
-    def __init__(self, manager: CacheManager, max_workers: int = 16) -> None:
+    def __init__(
+        self,
+        manager: CacheManager,
+        max_workers: int = 16,
+        batch_window_ms: float = 0.0,
+        batch_max_size: int = 64,
+    ) -> None:
         self.manager = manager
         # JAX dispatch is effectively serialized per device; a few workers
         # keep fetch/compile of different models overlapping inference.
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="tpusc-serve")
+        if batch_window_ms > 0:
+            from tfservingcache_tpu.runtime.batcher import MicroBatcher
+
+            self._predictor = MicroBatcher(
+                manager.runtime, window_ms=batch_window_ms, max_batch=batch_max_size
+            )
+        else:
+            self._predictor = manager.runtime
 
     async def _run(self, fn, *args):
         # copy_context: the executor job joins the request's ambient trace
@@ -86,7 +100,7 @@ class LocalServingBackend(ServingBackend):
     ) -> dict[str, np.ndarray]:
         try:
             self.manager.ensure_servable(model_id)
-            return self.manager.runtime.predict(model_id, inputs, output_filter)
+            return self._predictor.predict(model_id, inputs, output_filter)
         except ModelNotFoundError as e:
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
         except RuntimeError_ as e:
@@ -161,7 +175,7 @@ class LocalServingBackend(ServingBackend):
         self._ensure_sync(model_id)
         in_spec, _, _ = self.manager.runtime.signature(model_id)
         arrays = self._examples_to_inputs(inp, in_spec)
-        outputs = self.manager.runtime.predict(model_id, arrays)
+        outputs = self._predictor.predict(model_id, arrays)
         result = sv.ClassificationResult()
         # scores: prefer explicit "scores", else softmax over "logits"
         scores = outputs.get("scores")
@@ -201,7 +215,7 @@ class LocalServingBackend(ServingBackend):
         self._ensure_sync(model_id)
         in_spec, out_spec, _ = self.manager.runtime.signature(model_id)
         arrays = self._examples_to_inputs(inp, in_spec)
-        outputs = self.manager.runtime.predict(model_id, arrays)
+        outputs = self._predictor.predict(model_id, arrays)
         name = "outputs" if "outputs" in outputs else next(iter(out_spec))
         vals = np.asarray(outputs[name], dtype=np.float64).reshape(-1)
         result = sv.RegressionResult()
@@ -311,7 +325,7 @@ class LocalServingBackend(ServingBackend):
                 for f in request.feed
             }
             fetch = [f.split(":")[0] for f in request.fetch] or None
-            return self.manager.runtime.predict(model_id, inputs, fetch)
+            return self._predictor.predict(model_id, inputs, fetch)
 
         outputs = await self._run(run)
         resp = sv.SessionRunResponse()
@@ -367,7 +381,7 @@ class LocalServingBackend(ServingBackend):
             except codec.CodecError as e:
                 raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
             row = "instances" in payload
-            return self.manager.runtime.predict(model_id, arrays), row
+            return self._predictor.predict(model_id, arrays), row
 
         outputs, row = await self._run(lambda: run())
         try:

@@ -1,0 +1,226 @@
+"""Adaptive micro-batching for the in-process runtime.
+
+Reference-parity rationale: the reference delegates request batching to TF
+Serving's ``--enable_batching`` (the sidecar never sees tensors); with
+inference in-process, the batcher moves here. TPU-first motivation: one
+batched MXU dispatch amortizes per-call host->device overhead — the dominant
+warm-path cost for small models — and a power-of-two padded batch keeps the
+jit cache small (runtime._pad_to_bucket already buckets the batch axis).
+
+Leader/follower design: concurrent ``predict`` calls for the same
+(model, non-batch shape, filter) key concatenate along the named "batch"
+axis. The first arrival becomes the leader, waits up to ``window_ms``
+(cut short when ``max_batch`` rows accumulate), runs ONE runtime.predict,
+and splits the outputs back by each caller's row count. Calls are
+thread-blocking by design — they arrive on the protocol backend's executor
+threads (protocol/local_backend.py), never on the event loop.
+
+Models whose inputs have no named "batch" axis fall through unbatched.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Mapping
+
+import numpy as np
+
+from tfservingcache_tpu.runtime.base import BaseRuntime
+from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.tracing import TRACER
+
+log = get_logger("runtime.batcher")
+
+
+@dataclass
+class _Slot:
+    inputs: Mapping[str, np.ndarray]
+    rows: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: dict[str, np.ndarray] | None = None
+    error: BaseException | None = None
+
+
+@dataclass
+class _Pending:
+    slots: list[_Slot] = field(default_factory=list)
+    rows: int = 0
+    closed: bool = False                  # no further joiners
+    full: threading.Event = field(default_factory=threading.Event)
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        runtime: BaseRuntime,
+        window_ms: float = 2.0,
+        max_batch: int = 64,
+        wait_timeout_s: float = 600.0,
+    ) -> None:
+        self.runtime = runtime
+        self.window_s = window_ms / 1e3
+        self.max_batch = max_batch
+        # generous: a follower may sit behind the leader's cold jit compile
+        self.wait_timeout_s = wait_timeout_s
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, _Pending] = {}
+        # observability
+        self.batches = 0
+        self.batched_requests = 0
+
+    # -- key/axis helpers ---------------------------------------------------
+    def _batch_axes(self, model_id: ModelId) -> dict[str, int] | None:
+        """Input name -> axis index of its named "batch" axis; None when any
+        input OR output lacks one. An output with no batch axis is reduced
+        OVER the batch (a scalar score, a pooled aggregate): coalescing would
+        compute it across other callers' rows — wrong answers and a
+        cross-request leak — so such models always run solo."""
+        input_spec, output_spec, _ = self.runtime.signature(model_id)
+        axes: dict[str, int] = {}
+        for name, spec in input_spec.items():
+            ax = [i for i, n in spec.dynamic_axes() if n == "batch"]
+            if not ax:
+                return None
+            axes[name] = ax[0]
+        for spec in output_spec.values():
+            if not any(n == "batch" for _, n in spec.dynamic_axes()):
+                return None
+        return axes
+
+    def _key(
+        self,
+        model_id: ModelId,
+        inputs: Mapping[str, np.ndarray],
+        axes: Mapping[str, int],
+        output_filter: list[str] | None,
+    ) -> tuple | None:
+        """Batchable only when every input's batch-axis row count agrees and
+        all non-batch dims match across joiners (exact-shape coalescing)."""
+        if set(inputs) != set(axes):
+            return None  # wrong input set: let runtime.predict raise cleanly
+        rows = None
+        sig = []
+        for name in sorted(inputs):
+            arr = np.asarray(inputs[name])
+            ax = axes.get(name)
+            if ax is None or arr.ndim <= ax:
+                return None
+            if rows is None:
+                rows = arr.shape[ax]
+            elif arr.shape[ax] != rows:
+                return None
+            rest = tuple(d for i, d in enumerate(arr.shape) if i != ax)
+            sig.append((name, str(arr.dtype), rest))
+        return (model_id, tuple(sig), tuple(output_filter or ()))
+
+    # -- core ---------------------------------------------------------------
+    def predict(
+        self,
+        model_id: ModelId,
+        inputs: Mapping[str, np.ndarray],
+        output_filter: list[str] | None = None,
+    ) -> dict[str, np.ndarray]:
+        axes = self._batch_axes(model_id)
+        key = self._key(model_id, inputs, axes, output_filter) if axes else None
+        if key is None:
+            return self.runtime.predict(model_id, inputs, output_filter)
+
+        first = sorted(inputs)[0]
+        rows = int(np.asarray(inputs[first]).shape[axes[first]])
+        if rows >= self.max_batch:
+            # already at/over the cap on its own: run solo, never join a batch
+            return self.runtime.predict(model_id, inputs, output_filter)
+        slot = _Slot(inputs=inputs, rows=rows)
+        with self._lock:
+            pend = self._pending.get(key)
+            if pend is not None and pend.rows + rows > self.max_batch:
+                # max_batch is a hard cap: close the full batch for its
+                # leader and start a fresh one with this request
+                pend.closed = True
+                self._pending.pop(key, None)
+                pend.full.set()
+                pend = None
+            leader = pend is None
+            if leader:
+                pend = _Pending()
+                self._pending[key] = pend
+            pend.slots.append(slot)
+            pend.rows += rows
+            if pend.rows >= self.max_batch:
+                pend.closed = True
+                self._pending.pop(key, None)
+                pend.full.set()
+
+        if not leader:
+            if not slot.done.wait(self.wait_timeout_s):
+                raise TimeoutError(f"batched predict for {model_id} timed out")
+            if slot.error is not None:
+                raise slot.error
+            assert slot.result is not None
+            return slot.result
+
+        # leader: give followers the window, then take the batch private
+        pend.full.wait(self.window_s)
+        with self._lock:
+            if not pend.closed:
+                pend.closed = True
+                self._pending.pop(key, None)
+        slots = pend.slots
+
+        try:
+            if len(slots) == 1:
+                out = self.runtime.predict(model_id, slot.inputs, output_filter)
+                slot.result = out
+                return out
+            with TRACER.span(
+                "microbatch", model=str(model_id), requests=len(slots), rows=pend.rows
+            ):
+                cat = {
+                    name: np.concatenate(
+                        [np.asarray(s.inputs[name]) for s in slots], axis=axes[name]
+                    )
+                    for name in slots[0].inputs
+                }
+                out = self.runtime.predict(model_id, cat, output_filter)
+                self.batches += 1
+                self.batched_requests += len(slots)
+                self._scatter(model_id, slots, out)
+            assert slot.result is not None
+            return slot.result
+        except BaseException as e:
+            for s in slots:
+                if s is not slot and s.result is None and s.error is None:
+                    s.error = e
+                    s.done.set()
+            raise
+        finally:
+            for s in slots:
+                if s is not slot:
+                    s.done.set()
+
+    def _scatter(self, model_id: ModelId, slots: list[_Slot], out: dict[str, np.ndarray]) -> None:
+        """Split batched outputs back per caller by row ranges; outputs with
+        no named "batch" axis replicate to every caller."""
+        _, out_spec, _ = self.runtime.signature(model_id)
+        offsets = []
+        start = 0
+        for s in slots:
+            offsets.append((start, start + s.rows))
+            start += s.rows
+
+        for i, s in enumerate(slots):
+            lo, hi = offsets[i]
+            result: dict[str, np.ndarray] = {}
+            for name, arr in out.items():
+                spec = out_spec.get(name)
+                ax = None
+                if spec is not None:
+                    batch_axes = [a for a, n in spec.dynamic_axes() if n == "batch"]
+                    ax = batch_axes[0] if batch_axes else None
+                if ax is not None and np.asarray(arr).ndim > ax and arr.shape[ax] == start:
+                    result[name] = np.take(arr, range(lo, hi), axis=ax)
+                else:
+                    result[name] = arr
+            s.result = result

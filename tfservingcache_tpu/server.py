@@ -50,7 +50,11 @@ class CacheNode:
                 mesh = group_mesh(jax.devices(), cfg.mesh.chips_per_group, 0)
             runtime = TPUModelRuntime(cfg.serving, self.metrics, mesh=mesh)
         self.manager = CacheManager(provider, disk_cache, runtime, self.metrics)
-        self.backend = LocalServingBackend(self.manager)
+        self.backend = LocalServingBackend(
+            self.manager,
+            batch_window_ms=cfg.serving.batch_window_ms,
+            batch_max_size=cfg.serving.batch_max_size,
+        )
         self.rest = RestServingServer(
             self.backend,
             self.metrics,
