@@ -1,0 +1,212 @@
+"""KV-cached autoregressive generation for the transformer_lm family.
+
+No reference counterpart (the reference proxies opaque Predict calls —
+SURVEY.md §5); generation is where a TPU-native LM server must not re-run
+the full sequence per token. Design:
+
+  - prefill: one full forward over the prompt that also WRITES each layer's
+    K/V into a preallocated (B, n_kv, max_len, head_dim) cache — the prompt
+    is processed at MXU-friendly width once;
+  - decode: a ``lax.scan`` over new tokens, each step attending one query
+    position against the cache — static shapes, one compiled program for
+    the whole generation, no per-token Python dispatch;
+  - sampling: greedy or temperature/top-k, PRNG threaded through the scan.
+
+The whole generate (prefill + scan + sampling) is a single jittable
+function: compile once per (batch, prompt-bucket, max_new_tokens) and reuse.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.transformer_lm import _rmsnorm
+
+
+def init_cache(cfg: dict, batch: int, max_len: int) -> dict:
+    """Preallocated per-layer K/V buffers. bf16 storage halves HBM traffic;
+    attention still accumulates in f32."""
+    n_kv = cfg["n_kv_heads"]
+    head_dim = cfg["d_model"] // cfg["n_heads"]
+    dtype = jnp.dtype(cfg["dtype"])
+    return {
+        "k": jnp.zeros((cfg["n_layers"], batch, n_kv, max_len, head_dim), dtype),
+        "v": jnp.zeros((cfg["n_layers"], batch, n_kv, max_len, head_dim), dtype),
+    }
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """logits (B, V) -> token ids (B,). temperature==0 is argmax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg_key", "max_new_tokens", "temperature", "top_k"),
+)
+def _generate_jit(
+    params,
+    input_ids,
+    prompt_len,
+    rng,
+    *,
+    cfg_key,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+):
+    cfg = dict(cfg_key)
+    b, s_max = input_ids.shape
+    max_len = s_max + max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+
+    # prefill the (right-padded) prompt block — the start_pos = 0 case of the
+    # per-example forward; padding positions write junk K/V but the per-step
+    # mask keeps them invisible until overwritten
+    logits, cache = _forward_cached_dyn(
+        params, input_ids, cache, jnp.zeros((b,), jnp.int32), cfg
+    )
+    # last REAL prompt token's logits seed the first sampled token
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
+    rng, sub = jax.random.split(rng)
+    tok = _sample(last, sub, temperature, top_k)
+
+    def step(carry, _):
+        cache, tok, pos, rng = carry
+        logits, cache = _forward_cached_one(params, tok, cache, pos, cfg)
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, 0], sub, temperature, top_k)
+        return (cache, nxt, pos + 1, rng), tok
+
+    def _forward_cached_one(params, tok, cache, pos, cfg):
+        # single-token step at per-example positions ``pos`` (B,)
+        return _forward_cached_dyn(params, tok[:, None], cache, pos, cfg)
+
+    (cache, _, _, _), toks = jax.lax.scan(
+        step, (cache, tok, prompt_len, rng), None, length=max_new_tokens
+    )
+    return jnp.transpose(toks, (1, 0))  # (B, max_new_tokens)
+
+
+def _forward_cached_dyn(params, input_ids, cache, start_pos, cfg):
+    """Like _forward_cached but with PER-EXAMPLE start positions (B,) —
+    needed because prompts in one batch have different true lengths."""
+    dtype = jnp.dtype(cfg["dtype"])
+    b, s_len = input_ids.shape
+    n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
+    head_dim = cfg["d_model"] // n_heads
+    positions = start_pos[:, None] + jnp.arange(s_len)[None, :]   # (B, S)
+
+    x = params["embed"][input_ids].astype(dtype)
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        attn = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["attn"])
+        h = _rmsnorm(x, layer["ln1"])
+        q = (h @ attn["wq"]).reshape(b, s_len, n_heads, head_dim).transpose(0, 2, 1, 3)
+        k = (h @ attn["wk"]).reshape(b, s_len, n_kv, head_dim).transpose(0, 2, 1, 3)
+        v = (h @ attn["wv"]).reshape(b, s_len, n_kv, head_dim).transpose(0, 2, 1, 3)
+        q = _rope_per_example(q, positions, cfg["rope_theta"])
+        k = _rope_per_example(k, positions, cfg["rope_theta"])
+
+        # scatter each example's K/V row into its own cache offset
+        def upd(cache_l, kv):
+            def one(c, kv_b, p):
+                return jax.lax.dynamic_update_slice(c, kv_b, (0, p, 0))
+            return jax.vmap(one)(cache_l, kv, start_pos)
+
+        k_cache = upd(cache["k"][li], k.astype(cache["k"].dtype))
+        v_cache = upd(cache["v"][li], v.astype(cache["v"].dtype))
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+
+        # per-example visibility: key pos <= query pos
+        d = q.shape[-1]
+        kk = k_cache
+        vv = v_cache
+        if n_kv != n_heads:
+            kk = jnp.repeat(kk, n_heads // n_kv, axis=1)
+            vv = jnp.repeat(vv, n_heads // n_kv, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kk.astype(jnp.float32))
+        s = s / math.sqrt(d)
+        k_pos = jnp.arange(kk.shape[2])
+        mask = k_pos[None, None, :] <= positions[:, :, None]      # (B, S, max_len)
+        s = jnp.where(mask[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32)).astype(q.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s_len, cfg["d_model"])
+        x = x + out @ attn["wo"]
+        mlp = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["mlp"])
+        hh = _rmsnorm(x, layer["ln2"])
+        x = x + (jax.nn.silu(hh @ mlp["w1"]) * (hh @ mlp["w3"])) @ mlp["w2"]
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def _rope_per_example(x, positions, theta):
+    """Rotary embedding with per-example positions (B, S) over (B, H, S, D)."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * freqs[None, None, :]  # (B,S,d/2)
+    cos = jnp.cos(angles)[:, None]                                            # (B,1,S,d/2)
+    sin = jnp.sin(angles)[:, None]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rot = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rot.reshape(x.shape).astype(x.dtype)
+
+
+def generate(
+    model_def: Any,
+    params: Any,
+    input_ids,
+    prompt_lengths=None,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng=None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` per row of ``input_ids`` (B, S prompt,
+    right-padded to a common S; ``prompt_lengths`` gives true lengths).
+
+    Only decoder-LM families with the transformer_lm parameter layout are
+    supported. Returns (B, max_new_tokens) int32 token ids.
+    """
+    if model_def.family != "transformer_lm":
+        raise ValueError(f"generation supports transformer_lm, not {model_def.family!r}")
+    import numpy as np
+
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, s = input_ids.shape
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((b,), s, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    cfg = model_def.config
+    if s + max_new_tokens > cfg["max_seq"]:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {max_new_tokens} exceeds max_seq {cfg['max_seq']}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
+    return _generate_jit(
+        params,
+        input_ids,
+        prompt_lengths,
+        rng,
+        cfg_key=cfg_key,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+    )

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import json
+import secrets
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Mapping
 
@@ -354,7 +355,7 @@ class LocalServingBackend(ServingBackend):
             return await self._rest_status(model_id)
         if method == "GET" and verb == "metadata":
             return await self._rest_metadata(model_id)
-        if method != "POST" or verb not in ("predict", "classify", "regress"):
+        if method != "POST" or verb not in ("predict", "classify", "regress", "generate"):
             raise BackendError(
                 f"unsupported {method} {verb or ''} request", grpc.StatusCode.UNIMPLEMENTED, 405
             )
@@ -365,6 +366,8 @@ class LocalServingBackend(ServingBackend):
 
         if verb == "predict":
             return await self._rest_predict(model_id, payload)
+        if verb == "generate":
+            return await self._rest_generate(model_id, payload)
         return await self._rest_classify_regress(model_id, verb, payload)
 
     async def _rest_predict(self, model_id: ModelId, payload: dict) -> RestResponse:
@@ -389,6 +392,48 @@ class LocalServingBackend(ServingBackend):
         except codec.CodecError as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
         return RestResponse(status=200, body=body)
+
+    async def _rest_generate(self, model_id: ModelId, payload: dict) -> RestResponse:
+        """tpusc extension verb ``:generate`` — KV-cached decoding.
+
+        Body: {"input_ids": [[...]], "prompt_lengths": [...]?,
+               "max_new_tokens": N?, "temperature": t?, "top_k": k?, "seed": s?}
+        Response: {"tokens": [[...]]}.
+
+        Omitting "seed" draws fresh entropy per request (distinct samples);
+        pass an explicit seed for reproducible completions.
+        """
+        ids = payload.get("input_ids")
+        if not isinstance(ids, list) or not ids:
+            raise BackendError(
+                '"input_ids" must be a non-empty 2-D list',
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+
+        def run() -> np.ndarray:
+            self._ensure_sync(model_id)
+            try:
+                return self.manager.runtime.generate(
+                    model_id,
+                    np.asarray(ids, np.int32),
+                    prompt_lengths=payload.get("prompt_lengths"),
+                    max_new_tokens=int(payload.get("max_new_tokens", 32)),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    seed=(
+                        int(payload["seed"])
+                        if "seed" in payload
+                        else secrets.randbits(31)
+                    ),
+                )
+            except (ValueError, TypeError) as e:
+                raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
+
+        try:
+            tokens = await self._run(run)
+        except RuntimeError_ as e:
+            raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
+        return RestResponse(status=200, body=json.dumps({"tokens": tokens.tolist()}).encode())
 
     async def _rest_classify_regress(
         self, model_id: ModelId, verb: str, payload: dict

@@ -36,7 +36,9 @@ log = get_logger("rest")
 # reference regex, tfservingproxy.go:24
 URL_RE = re.compile(r"^/v1/models/(?P<name>[^/]+?)(/versions/(?P<version>[0-9]+))?$", re.I)
 
-VERBS = ("predict", "classify", "regress")
+# "generate" is a tpusc extension verb (KV-cached autoregressive decoding);
+# the reference protocol verbs are predict/classify/regress
+VERBS = ("predict", "classify", "regress", "generate")
 
 
 def _error_body(message: str) -> bytes:

@@ -75,6 +75,20 @@ class BaseRuntime(abc.ABC):
     def signature(self, model_id: ModelId) -> tuple[dict[str, TensorSpec], dict[str, TensorSpec], str]:
         """-> (input_spec, output_spec, method_name) for a loaded model."""
 
+    def generate(
+        self,
+        model_id: ModelId,
+        input_ids: np.ndarray,
+        prompt_lengths=None,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """KV-cached autoregressive decoding (tpusc extension verb); runtimes
+        without a decoder path keep this default."""
+        raise RuntimeError_("this runtime does not support generation")
+
     @abc.abstractmethod
     def check(self) -> None:
         """Raise when the runtime/accelerator is unhealthy."""

@@ -66,6 +66,10 @@ class MicroBatcher:
         self.wait_timeout_s = wait_timeout_s
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Pending] = {}
+        # signature() results are static per loaded model — cache the derived
+        # axis maps so the hot path doesn't rebuild spec dicts per request
+        self._axes_cache: dict[ModelId, dict[str, int] | None] = {}
+        self._out_axes_cache: dict[ModelId, dict[str, int | None]] = {}
         # observability
         self.batches = 0
         self.batched_requests = 0
@@ -77,16 +81,32 @@ class MicroBatcher:
         OVER the batch (a scalar score, a pooled aggregate): coalescing would
         compute it across other callers' rows — wrong answers and a
         cross-request leak — so such models always run solo."""
+        with self._lock:
+            if model_id in self._axes_cache:
+                return self._axes_cache[model_id]
         input_spec, output_spec, _ = self.runtime.signature(model_id)
-        axes: dict[str, int] = {}
+        axes: dict[str, int] | None = {}
         for name, spec in input_spec.items():
             ax = [i for i, n in spec.dynamic_axes() if n == "batch"]
             if not ax:
-                return None
+                axes = None
+                break
             axes[name] = ax[0]
-        for spec in output_spec.values():
-            if not any(n == "batch" for _, n in spec.dynamic_axes()):
-                return None
+        if axes is not None:
+            for spec in output_spec.values():
+                if not any(n == "batch" for _, n in spec.dynamic_axes()):
+                    axes = None
+                    break
+        out_axes: dict[str, int | None] = {}
+        for name, spec in output_spec.items():
+            batch_axes = [a for a, n in spec.dynamic_axes() if n == "batch"]
+            out_axes[name] = batch_axes[0] if batch_axes else None
+        with self._lock:
+            if len(self._axes_cache) > 4096:  # bound growth across tenants
+                self._axes_cache.clear()
+                self._out_axes_cache.clear()
+            self._axes_cache[model_id] = axes
+            self._out_axes_cache[model_id] = out_axes
         return axes
 
     def _key(
@@ -203,7 +223,8 @@ class MicroBatcher:
     def _scatter(self, model_id: ModelId, slots: list[_Slot], out: dict[str, np.ndarray]) -> None:
         """Split batched outputs back per caller by row ranges; outputs with
         no named "batch" axis replicate to every caller."""
-        _, out_spec, _ = self.runtime.signature(model_id)
+        with self._lock:
+            out_axes = dict(self._out_axes_cache.get(model_id, {}))
         offsets = []
         start = 0
         for s in slots:
@@ -214,11 +235,7 @@ class MicroBatcher:
             lo, hi = offsets[i]
             result: dict[str, np.ndarray] = {}
             for name, arr in out.items():
-                spec = out_spec.get(name)
-                ax = None
-                if spec is not None:
-                    batch_axes = [a for a, n in spec.dynamic_axes() if n == "batch"]
-                    ax = batch_axes[0] if batch_axes else None
+                ax = out_axes.get(name)
                 if ax is not None and np.asarray(arr).ndim > ax and arr.shape[ax] == start:
                     result[name] = np.take(arr, range(lo, hi), axis=ax)
                 else:

@@ -104,6 +104,24 @@ class FakeRuntime(BaseRuntime):
             "tensorflow/serving/predict",
         )
 
+    def generate(
+        self,
+        model_id: ModelId,
+        input_ids,
+        prompt_lengths=None,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+    ):
+        import numpy as np
+
+        if not self.is_loaded(model_id):
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        b = np.asarray(input_ids).shape[0]
+        # deterministic fake: token id == model version
+        return np.full((b, max_new_tokens), model_id.version, np.int32)
+
     def check(self) -> None:
         pass
 

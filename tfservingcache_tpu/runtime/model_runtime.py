@@ -298,6 +298,76 @@ class TPUModelRuntime(BaseRuntime):
             padded[name] = np.pad(arr, pad) if changed else arr
         return dyn_sizes, padded
 
+    def generate(
+        self,
+        model_id: ModelId,
+        input_ids: np.ndarray,
+        prompt_lengths: list[int] | None = None,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """KV-cached autoregressive decoding (models/generation.py).
+
+        Prompt seq and max_new_tokens are padded to power-of-two buckets so
+        one compiled generate program serves the whole bucket; output is
+        truncated to the requested token count. (B, max_new_tokens) int32.
+        """
+        import jax
+
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        if loaded.model_def.family != "transformer_lm":
+            raise RuntimeError_(
+                f"generate is supported for transformer_lm models, "
+                f"not {loaded.model_def.family!r}"
+            )
+        from tfservingcache_tpu.models.generation import generate as gen
+
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim != 2 or not ids.size:
+            raise RuntimeError_(f"input_ids must be (batch, seq), got {ids.shape}")
+        b, s = ids.shape
+        if prompt_lengths is None:
+            lengths = np.full((b,), s, np.int32)
+        else:
+            lengths = np.asarray(prompt_lengths, np.int32)
+            if lengths.shape != (b,) or (lengths < 1).any() or (lengths > s).any():
+                raise RuntimeError_(f"bad prompt_lengths {lengths!r} for shape {ids.shape}")
+        if max_new_tokens < 1:
+            raise RuntimeError_("max_new_tokens must be >= 1")
+        max_seq = loaded.model_def.config["max_seq"]
+        s_bucket = next_bucket(s)
+        new_bucket = next_bucket(max_new_tokens)
+        if s_bucket + new_bucket > max_seq:
+            # bucket overshoot may exceed max_seq even when the true request
+            # fits; fall back to exact sizes before rejecting
+            s_bucket, new_bucket = s, max_new_tokens
+            if s + max_new_tokens > max_seq:
+                raise RuntimeError_(
+                    f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+                    f"max_seq {max_seq}"
+                )
+        if s_bucket != s:
+            ids = np.pad(ids, ((0, 0), (0, s_bucket - s)))
+        with TRACER.span(
+            "generate", model=str(model_id), tokens=new_bucket, batch=b
+        ):
+            toks = gen(
+                loaded.model_def,
+                loaded.params,
+                ids,
+                prompt_lengths=lengths,
+                max_new_tokens=new_bucket,
+                temperature=temperature,
+                top_k=top_k,
+                rng=jax.random.PRNGKey(seed),
+            )
+            toks = np.asarray(jax.device_get(toks))
+        return toks[:, :max_new_tokens]
+
     # -- unload / introspection --------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
         self._set_state(model_id, ModelState.UNLOADING)
