@@ -1,112 +1,487 @@
 #!/usr/bin/env python
-"""Headline benchmark: multi-tenant cold-miss load->first-predict latency.
+"""Headline benchmark: multi-tenant cold-miss latency + warm serving QPS + MFU.
 
-BASELINE.md target: cold-miss p50 <= 2 s (the reference publishes no numbers
-of its own — BASELINE.json `published: {}` — so the target is the bar).
+BASELINE.md target: cold-miss load->first-predict p50 <= 2 s (the reference
+publishes no numbers of its own — BASELINE.json ``published: {}`` — so that
+target is the bar). vs_baseline = target_s / measured_p50 (>1.0 beats it).
 
-Scenario (BASELINE.json configs #1/#2): N per-tenant model artifacts in a
-disk store; a fresh cache node serves each tenant's first request cold
-(fetch -> compile -> pin to HBM -> predict), then a warm QPS loop on one
-tenant. Prints ONE JSON line:
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-vs_baseline = target_s / measured_p50 (>1.0 beats the 2 s target).
+What it measures (VERDICT.md round-1 item #1):
+  - cold-miss p50/p95 over N tenants (fetch -> compile -> pin -> predict),
+    for mnist_cnn AND transformer_lm — per-family executables are shared, so
+    tenant 2..N cold cost is params-transfer only;
+  - warm CONCURRENT QPS through the real REST server (aiohttp clients, not
+    direct runtime.predict), micro-batcher on vs off;
+  - transformer_lm prefill/decode throughput and MFU vs the chip's peak.
+
+Robustness (round-1 failure mode was rc=1 at backend init): the backend is
+probed in a CHILD process with a timeout + retries; on failure the bench
+falls back to CPU and stamps the diagnostic into the JSON. A watchdog
+guarantees exactly one JSON line lands on stdout no matter what hangs.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
+import subprocess
 import sys
 import tempfile
+import threading
 import time
 
+TARGET_S = 2.0
 
-def run_bench(family: str, tenants: int, warm_iters: int, batch: int) -> dict:
-    import numpy as np
+_print_lock = threading.Lock()
+_printed = False
 
+
+def emit(payload: dict) -> None:
+    """Print THE one JSON line (first caller wins; watchdog may race us)."""
+    global _printed
+    with _print_lock:
+        if _printed:
+            return
+        _printed = True
+        print(json.dumps(payload), flush=True)
+
+
+def probe_backend(timeout_s: float, attempts: int = 3) -> tuple[str, str]:
+    """-> (platform, diagnostic). Tries the configured backend (axon TPU
+    tunnel here) in a child process so an init hang can't wedge the bench;
+    retries with backoff, then falls back to CPU."""
+    code = (
+        "import jax, json; d = jax.devices();"
+        "import jax.numpy as jnp;"
+        "x = (jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready();"
+        "print(json.dumps({'platform': d[0].platform,"
+        " 'kind': getattr(d[0], 'device_kind', '?'), 'n': len(d)}))"
+    )
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu", "cpu forced by JAX_PLATFORMS env"
+    last = ""
+    for attempt in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                info = json.loads(r.stdout.strip().splitlines()[-1])
+                return info["platform"], f"backend ok: {info}"
+            last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["?"]
+            last = f"rc={r.returncode}: {last[0][:300]}"
+        except subprocess.TimeoutExpired:
+            last = f"init timed out after {timeout_s:.0f}s"
+        except Exception as e:  # noqa: BLE001
+            last = f"{type(e).__name__}: {e}"
+        time.sleep(min(5.0 * (attempt + 1), 15.0))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu", f"tpu backend unusable ({last}); fell back to cpu"
+
+
+# transformer_lm bench preset: head_dim 64 so the Pallas flash-attention
+# kernel dispatches on TPU (ops/attention.py gate), GQA exercised, seq 128+
+LM_BENCH_CONFIG = {
+    "vocab_size": 4096,
+    "d_model": 512,
+    "n_layers": 4,
+    "n_heads": 8,
+    "n_kv_heads": 4,
+    "d_ff": 2048,
+    "max_seq": 1024,
+    "rope_theta": 10000.0,
+    "dtype": "bfloat16",
+}
+
+# CPU-fallback preset: the fallback exists to prove the harness end-to-end
+# when the TPU tunnel is down, not to measure — XLA:CPU compiles of the full
+# preset take minutes and would trip the watchdog
+LM_BENCH_CONFIG_CPU = {
+    "vocab_size": 1024,
+    "d_model": 128,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 512,
+    "max_seq": 512,
+    "rope_theta": 10000.0,
+    "dtype": "bfloat16",
+}
+
+# published per-chip bf16 peak FLOP/s by device kind substring
+_PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    dk = device_kind.lower()
+    for key, peak in _PEAK_FLOPS.items():
+        if key in dk:
+            return peak
+    return None
+
+
+def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
+                config: dict | None = None):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
     from tfservingcache_tpu.config import ServingConfig
-    from tfservingcache_tpu.models.registry import build, export_artifact
+    from tfservingcache_tpu.models.registry import export_artifact
     from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
-    from tfservingcache_tpu.types import ModelId
 
-    tmp = tempfile.mkdtemp(prefix="tpusc-bench-")
-    store = f"{tmp}/store"
+    store = os.path.join(tmp, f"store-{family}")
     for i in range(tenants):
-        export_artifact(family, store, name=f"tenant{i}", version=1, seed=i)
-
-    model_def = build(family)
-    rng = np.random.default_rng(0)
-    inputs = {
-        name: rng.normal(
-            size=tuple(
-                batch if isinstance(d, str) else d for d in spec.norm_shape()
-            )
-        ).astype(spec.np_dtype())
-        for name, spec in model_def.input_spec.items()
-    }
-
+        export_artifact(family, store, name=f"tenant{i}", version=1, seed=i,
+                        config=config)
     provider = DiskModelProvider(store)
-    cache = ModelDiskCache(f"{tmp}/cache", capacity_bytes=64 << 30)
+    cache = ModelDiskCache(
+        os.path.join(tmp, f"cache-{family}"), capacity_bytes=64 << 30
+    )
     runtime = TPUModelRuntime(
-        ServingConfig(hbm_capacity_bytes=8 << 30, max_concurrent_models=max(tenants, 4))
+        ServingConfig(
+            hbm_capacity_bytes=hbm_gb << 30,
+            max_concurrent_models=max(tenants, 4),
+        )
     )
     manager = CacheManager(provider, cache, runtime)
+    return manager, runtime
 
-    cold_times = []
+
+def _example_inputs(family: str, batch: int, config: dict | None = None):
+    import numpy as np
+
+    from tfservingcache_tpu.models.registry import build
+
+    model_def = build(family, config)
+    rng = np.random.default_rng(0)
+    out = {}
+    for name, spec in model_def.input_spec.items():
+        shape = tuple(batch if isinstance(d, str) else d for d in spec.norm_shape())
+        if family == "transformer_lm":
+            shape = (batch, 128)  # realistic prompt length
+            out[name] = rng.integers(
+                0, model_def.config["vocab_size"], shape
+            ).astype(spec.np_dtype())
+        elif spec.np_dtype().kind in "iu":
+            out[name] = rng.integers(0, 8, shape).astype(spec.np_dtype())
+        else:
+            out[name] = rng.normal(size=shape).astype(spec.np_dtype())
+    return out
+
+
+def bench_cold(family: str, tenants: int, batch: int, tmp: str,
+               config: dict | None = None) -> tuple:
+    """Cold-miss loop: every tenant's first request through the CacheManager."""
+    import numpy as np
+
+    from tfservingcache_tpu.types import ModelId
+
+    manager, runtime = _make_stack(family, tenants, tmp, config=config)
+    inputs = _example_inputs(family, batch, config)
+    times = []
     for i in range(tenants):
         mid = ModelId(f"tenant{i}", 1)
         t0 = time.perf_counter()
         manager.ensure_servable(mid)
         out = runtime.predict(mid, inputs)
         _ = {k: np.asarray(v) for k, v in out.items()}
-        cold_times.append(time.perf_counter() - t0)
-
-    # warm QPS on tenant 0
-    mid = ModelId("tenant0", 1)
-    runtime.predict(mid, inputs)  # ensure warm
-    t0 = time.perf_counter()
-    for _ in range(warm_iters):
-        runtime.predict(mid, inputs)
-    warm_dt = time.perf_counter() - t0
-    warm_qps = warm_iters * batch / warm_dt
-
-    p50 = statistics.median(cold_times)
-    return {
-        "cold_p50_s": p50,
-        "cold_p95_s": sorted(cold_times)[int(0.95 * (len(cold_times) - 1))],
-        "cold_first_s": cold_times[0],
-        "warm_qps": warm_qps,
-        "warm_ms_per_req": warm_dt / warm_iters * 1e3,
+        times.append(time.perf_counter() - t0)
+    stats = {
+        "cold_p50_s": statistics.median(times),
+        "cold_p95_s": sorted(times)[int(0.95 * (len(times) - 1))],
+        "cold_first_s": times[0],  # includes the one shared-family compile
     }
+    return stats, manager, runtime, inputs
+
+
+async def _rest_warm_qps(manager, family: str, inputs, duration_s: float,
+                         clients: int, batch_window_ms: float) -> float:
+    """Concurrent warm QPS through the real REST server (not direct
+    runtime.predict): aiohttp clients hammer :predict for duration_s."""
+    import asyncio
+
+    import aiohttp
+
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.rest import RestServingServer
+
+    backend = LocalServingBackend(manager, batch_window_ms=batch_window_ms)
+    rest = RestServingServer(backend, require_version=False)
+    port = await rest.start(0, host="127.0.0.1")
+    body = {"inputs": {k: v.tolist() for k, v in inputs.items()}}
+    url = f"http://127.0.0.1:{port}/v1/models/tenant0/versions/1:predict"
+    counts = [0] * clients
+    stop = 0.0  # set after the settle phase
+
+    async def worker(i: int, session) -> None:
+        while time.perf_counter() < stop:
+            async with session.post(url, json=body) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"predict failed: {await resp.text()}")
+                await resp.read()
+            counts[i] += 1
+
+    async with aiohttp.ClientSession() as session:
+        # settle phase: concurrent warm-up so coalesced-batch bucket compiles
+        # (8, 16, 32... rows) happen BEFORE the measured window
+        async with session.post(url, json=body) as resp:
+            assert resp.status == 200, await resp.text()
+
+        async def settle(i: int) -> None:
+            for _ in range(3):
+                async with session.post(url, json=body) as resp:
+                    await resp.read()
+
+        await asyncio.gather(*(settle(i) for i in range(clients)))
+        t0 = time.perf_counter()
+        stop = t0 + duration_s
+        await asyncio.gather(*(worker(i, session) for i in range(clients)))
+        dt = time.perf_counter() - t0
+    await rest.close()
+    backend.close()
+    return sum(counts) / dt
+
+
+def _lm_param_count(config: dict) -> int:
+    v, d, ff = config["vocab_size"], config["d_model"], config["d_ff"]
+    n_kv = config["n_kv_heads"]
+    head_dim = d // config["n_heads"]
+    kv = d * n_kv * head_dim
+    per_layer = d * d * 2 + kv * 2 + 3 * d * ff + 2 * d
+    return v * d + config["n_layers"] * per_layer + d
+
+
+def bench_lm_throughput(runtime, inputs, batch: int, config: dict,
+                        device_kind: str) -> dict:
+    """Prefill tokens/s + MFU, and KV-cached decode tokens/s."""
+    import numpy as np
+
+    from tfservingcache_tpu.types import ModelId
+
+    mid = ModelId("tenant0", 1)
+    seq = inputs["input_ids"].shape[1]
+    # prefill: full forward; ~2 * n_params FLOPs per token (weight matmuls)
+    # realistic LM serving pattern: full forward on device, only the last
+    # token's logits (B, V) shipped to host (derived output)
+    runtime.predict(mid, inputs, output_filter=["last_token_logits"])  # warm
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        runtime.predict(mid, inputs, output_filter=["last_token_logits"])
+    dt = time.perf_counter() - t0
+    prefill_tok_s = iters * batch * seq / dt
+    flops = 2.0 * _lm_param_count(config) * prefill_tok_s
+    peak = _peak_flops(device_kind)
+    # decode: KV-cached generation, tokens/s of new tokens
+    new_tokens = 64 if _peak_flops(device_kind) else 8
+    prompts = np.asarray(inputs["input_ids"][:, :32], np.int32)
+    runtime.generate(mid, prompts, max_new_tokens=new_tokens)  # warm/compile
+    t0 = time.perf_counter()
+    giter = 3
+    for _ in range(giter):
+        runtime.generate(mid, prompts, max_new_tokens=new_tokens)
+    gdt = time.perf_counter() - t0
+    decode_tok_s = giter * batch * new_tokens / gdt
+    out = {
+        "prefill_tok_s": prefill_tok_s,
+        "prefill_flops": flops,
+        "decode_tok_s": decode_tok_s,
+        "params": _lm_param_count(config),
+    }
+    if peak:
+        out["prefill_mfu"] = flops / peak
+        out["decode_mfu"] = 2.0 * _lm_param_count(config) * decode_tok_s / peak
+    return out
+
+
+def bench_flash_kernel() -> dict:
+    """On-TPU proof of the Pallas flash kernel (VERDICT.md weak #2): compile
+    interpret=False, check vs the jnp reference, time both at an LM shape."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tfservingcache_tpu.ops.attention import (
+        TPU_BACKENDS,
+        attention_reference,
+        flash_attention,
+    )
+
+    if jax.default_backend() not in TPU_BACKENDS:
+        return {"skipped": f"backend {jax.default_backend()} is not a TPU"}
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    shape = (4, 8, 1024, 64)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    err = float(
+        jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    )
+    ref_jit = jax.jit(attention_reference, static_argnames="causal")
+
+    def timeit(fn, iters=30):
+        fn().block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn()
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    t_flash = timeit(lambda: flash_attention(q, k, v, causal=True))
+    t_ref = timeit(lambda: ref_jit(q, k, v, causal=True))
+    return {
+        "shape_bhsd": list(shape),
+        "max_abs_err_vs_ref": round(err, 5),
+        "flash_ms": round(t_flash * 1e3, 3),
+        "jnp_ms": round(t_ref * 1e3, 3),
+        "speedup": round(t_ref / t_flash, 2),
+    }
+
+
+def run(args) -> dict:
+    detail: dict = {}
+    platform, diag = probe_backend(args.init_timeout_s)
+    detail["platform"] = platform
+    detail["backend_diag"] = diag
+
+    import asyncio
+
+    import jax
+
+    if platform == "cpu":
+        # the env var alone does NOT beat the axon plugin's registration —
+        # only the config update reliably forces CPU (see tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    device_kind = getattr(jax.devices()[0], "device_kind", platform)
+    detail["device_kind"] = device_kind
+    tmp = tempfile.mkdtemp(prefix="tpusc-bench-")
+
+    lm_config = LM_BENCH_CONFIG
+    if platform == "cpu":
+        # fallback mode: prove the harness, don't boil the host
+        args.tenants = min(args.tenants, 8)
+        args.warm_s = min(args.warm_s, 2.0)
+        lm_config = LM_BENCH_CONFIG_CPU
+        detail["scaled_down"] = "cpu fallback: fewer tenants, tiny LM preset"
+
+    # --- mnist_cnn: tenant-scale cold + REST warm QPS ---
+    cold, manager, runtime, inputs = bench_cold(
+        "mnist_cnn", args.tenants, args.batch, tmp
+    )
+    detail["mnist_cnn"] = dict(cold)
+    for window, key in ((0.0, "warm_rest_qps_nobatch"), (2.0, "warm_rest_qps_batch2ms")):
+        qps = asyncio.run(
+            _rest_warm_qps(manager, "mnist_cnn", inputs, args.warm_s,
+                           args.clients, window)
+        )
+        detail["mnist_cnn"][key] = round(qps, 1)
+    manager.close()
+
+    # --- transformer_lm: cold + prefill/decode + MFU ---
+    lm_tenants = max(4, args.tenants // 8)
+    lm_cold, lm_manager, lm_runtime, lm_inputs = bench_cold(
+        "transformer_lm", lm_tenants, args.lm_batch, tmp, config=lm_config
+    )
+    detail["transformer_lm"] = dict(lm_cold)
+    detail["transformer_lm"]["tenants"] = lm_tenants
+    detail["transformer_lm"].update(
+        {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in bench_lm_throughput(
+                lm_runtime, lm_inputs, args.lm_batch, lm_config, device_kind
+            ).items()
+        }
+    )
+    lm_qps = asyncio.run(
+        _rest_warm_qps(lm_manager, "transformer_lm", lm_inputs, args.warm_s,
+                       args.clients, 0.0)
+    )
+    detail["transformer_lm"]["warm_rest_qps"] = round(lm_qps, 1)
+    lm_manager.close()
+
+    try:
+        detail["flash_kernel"] = bench_flash_kernel()
+    except Exception as e:  # noqa: BLE001 - kernel trouble must not sink the bench
+        detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
+
+    for fam in ("mnist_cnn", "transformer_lm"):
+        detail[fam] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in detail[fam].items()
+        }
+    return detail
 
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--family", default="mnist_cnn")
-    parser.add_argument("--tenants", type=int, default=8)
-    parser.add_argument("--warm-iters", type=int, default=200)
+    parser.add_argument("--tenants", type=int, default=32)
     parser.add_argument("--batch", type=int, default=8)
-    parser.add_argument("--target-s", type=float, default=2.0)
+    parser.add_argument("--lm-batch", type=int, default=4)
+    parser.add_argument("--warm-s", type=float, default=5.0)
+    parser.add_argument("--clients", type=int, default=16)
+    parser.add_argument("--target-s", type=float, default=TARGET_S)
+    parser.add_argument("--init-timeout-s", type=float, default=240.0)
+    parser.add_argument("--budget-s", type=float, default=1500.0)
     args = parser.parse_args()
 
-    stats = run_bench(args.family, args.tenants, args.warm_iters, args.batch)
-    print(
-        json.dumps(
+    def watchdog() -> None:
+        time.sleep(args.budget_s)
+        emit(
             {
-                "metric": f"cold_miss_load_to_first_predict_p50 ({args.family}, "
-                f"{args.tenants} tenants; warm {stats['warm_qps']:.0f} qps)",
-                "value": round(stats["cold_p50_s"], 4),
+                "metric": "cold_miss_load_to_first_predict_p50 (TIMEOUT)",
+                "value": None,
                 "unit": "s",
-                "vs_baseline": round(args.target_s / stats["cold_p50_s"], 3),
+                "vs_baseline": 0.0,
+                "detail": {"error": f"bench exceeded {args.budget_s}s budget"},
             }
         )
-    )
-    print(json.dumps({"detail": {k: round(v, 4) for k, v in stats.items()}}), file=sys.stderr)
-    return 0
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    try:
+        detail = run(args)
+        p50 = detail["mnist_cnn"]["cold_p50_s"]
+        qps = detail["mnist_cnn"].get("warm_rest_qps_batch2ms", 0.0)
+        emit(
+            {
+                "metric": (
+                    f"cold_miss_load_to_first_predict_p50 (mnist_cnn, "
+                    f"{args.tenants} tenants, {detail['platform']}; "
+                    f"warm REST {qps:.0f} qps; lm prefill "
+                    f"{detail['transformer_lm'].get('prefill_tok_s', 0):.0f} tok/s)"
+                ),
+                "value": round(p50, 4),
+                "unit": "s",
+                "vs_baseline": round(args.target_s / p50, 3),
+                "detail": detail,
+            }
+        )
+        return 0
+    except BaseException as e:  # noqa: BLE001 - one JSON line, never a bare traceback
+        import traceback
+
+        emit(
+            {
+                "metric": "cold_miss_load_to_first_predict_p50 (FAILED)",
+                "value": None,
+                "unit": "s",
+                "vs_baseline": 0.0,
+                "detail": {
+                    "error": f"{type(e).__name__}: {e}",
+                    "trace": traceback.format_exc()[-1500:],
+                },
+            }
+        )
+        return 0
 
 
 if __name__ == "__main__":

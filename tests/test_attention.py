@@ -43,6 +43,65 @@ def test_flash_bf16():
     )
 
 
+def _repeat_kv(k, v, g):
+    return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("hq,hkv", [(8, 2), (4, 1), (6, 3)])
+def test_gqa_grouped_kv_matches_materialized_repeat(causal, hq, hkv):
+    # GQA-native paths (reference einsum grouping + flash index-map) must
+    # equal the naive repeat-K/V-to-full-heads computation exactly
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (2, hq, 128, 64))
+    k = jax.random.normal(ks[1], (2, hkv, 128, 64))
+    v = jax.random.normal(ks[2], (2, hkv, 128, 64))
+    kr, vr = _repeat_kv(k, v, hq // hkv)
+    want = attention_reference(q, kr, vr, causal=causal)
+    got_ref = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got_ref), np.asarray(want), atol=2e-5, rtol=2e-5)
+    got_flash = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_flash), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_rejects_non_divisible_heads():
+    q, k, v = rand_qkv(1, 3, 128, 64)
+    k2, v2 = k[:, :2], v[:, :2]
+    with pytest.raises(ValueError, match="multiple"):
+        attention_reference(q, k2, v2)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, k2, v2, interpret=True)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="needs real TPU (conftest forces CPU; run via tools/tpu_kernel_check.py)",
+)
+def test_flash_kernel_compiles_and_wins_on_tpu():
+    """Hardware proof for the Pallas kernel: compiles interpret=False,
+    matches the jnp reference, and beats it at LM-serving shapes."""
+    import time
+
+    q, k, v = rand_qkv(4, 8, 1024, 64, dtype=jnp.bfloat16, seed=5)
+    out = flash_attention(q, k, v, causal=True)  # interpret=False: real Mosaic compile
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    )
+
+    def timeit(fn, iters=20):
+        fn().block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn()
+        r.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    t_flash = timeit(lambda: flash_attention(q, k, v, causal=True))
+    t_ref = timeit(lambda: jax.jit(attention_reference, static_argnames="causal")(q, k, v, causal=True))
+    assert t_flash < t_ref, f"flash {t_flash*1e3:.2f}ms not faster than jnp {t_ref*1e3:.2f}ms"
+
+
 def test_flash_uneven_blocks():
     # block_k not dividing block_q's padding: lcm padding keeps both exact
     q, k, v = rand_qkv(1, 2, 128, 64, seed=3)

@@ -41,7 +41,7 @@ def load(rt, name="m", version=1) -> ModelId:
 def test_concurrent_requests_coalesce_into_fewer_device_calls():
     rt = make_runtime(delay_s=0.05)
     mid = load(rt)
-    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
 
     def one(i):
         x = np.array([float(i)], np.float32)
@@ -60,7 +60,7 @@ def test_concurrent_requests_coalesce_into_fewer_device_calls():
 def test_scatter_respects_row_counts_and_order():
     rt = make_runtime(delay_s=0.05)
     mid = load(rt, version=3)
-    b = MicroBatcher(rt, window_ms=50.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
     sizes = [1, 3, 2]
 
     def one(k):
@@ -79,7 +79,7 @@ def test_scatter_respects_row_counts_and_order():
 def test_max_batch_flushes_early():
     rt = make_runtime(delay_s=0.02)
     mid = load(rt)
-    b = MicroBatcher(rt, window_ms=10_000.0, max_batch=4)  # window never expires
+    b = MicroBatcher(rt, max_batch=4)
 
     def one(i):
         return b.predict(mid, {"x": np.array([float(i)], np.float32)})["y"][0]
@@ -95,7 +95,7 @@ def test_max_batch_flushes_early():
 def test_different_models_do_not_mix():
     rt = make_runtime(delay_s=0.05)
     m1, m2 = load(rt, "a", 1), load(rt, "b", 2)
-    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
 
     def one(mid, v):
         return float(b.predict(mid, {"x": np.array([v], np.float32)})["y"][0])
@@ -116,7 +116,7 @@ def test_error_propagates_to_all_waiters():
         raise RuntimeError("device on fire")
 
     rt.predict = boom
-    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
 
     def one(i):
         b.predict(mid, {"x": np.array([float(i)], np.float32)})
@@ -136,7 +136,7 @@ def test_model_without_batch_axis_falls_through():
         {"y": TensorSpec("float32", (4,))},
         "tensorflow/serving/predict",
     )
-    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
     out = b.predict(mid, {"x": np.ones((4,), np.float32)})
     np.testing.assert_allclose(out["y"], np.ones(4))
     assert b.batches == 0  # passthrough, not batched
@@ -152,7 +152,7 @@ def test_batch_reducing_output_falls_through():
         {"y": TensorSpec("float32", ())},   # scalar aggregate
         "tensorflow/serving/predict",
     )
-    b = MicroBatcher(rt, window_ms=40.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
     out = b.predict(mid, {"x": np.ones((2,), np.float32)})
     assert "y" in out
     assert b.batches == 0
@@ -169,7 +169,7 @@ def test_max_batch_is_a_hard_cap():
         return orig(m, inputs, f)
 
     rt.predict = record
-    b = MicroBatcher(rt, window_ms=60.0, max_batch=8)
+    b = MicroBatcher(rt, max_batch=8)
 
     def one(rows, base):
         x = np.full((rows,), base, np.float32)
@@ -185,10 +185,68 @@ def test_max_batch_is_a_hard_cap():
         np.testing.assert_allclose(outs[i], np.full((r,), float(i)))
 
 
+def test_scatter_shape_mismatch_fails_batch_instead_of_leaking():
+    # if the model's real output batch length disagrees with its spec, each
+    # caller must get an error — NOT the full concatenated array (which would
+    # hand callers each other's rows)
+    rt = make_runtime()
+    mid = load(rt)
+
+    def liar(m, inputs, f=None):
+        time.sleep(0.05)
+        return {"y": np.zeros((1,), np.float32)}  # always 1 row, whatever came in
+
+    rt.predict = liar
+    b = MicroBatcher(rt, max_batch=64)
+
+    def one(i):
+        return b.predict(mid, {"x": np.array([float(i), float(i)], np.float32)})
+
+    with ThreadPoolExecutor(max_workers=3) as pool:
+        first = pool.submit(one, 0)     # runs solo, occupies the gate 50ms
+        time.sleep(0.02)
+        futs = [pool.submit(one, i) for i in (1, 2)]  # coalesce behind it
+        first.result()                  # solo call can't leak; not asserted
+        errs = 0
+        for f in futs:
+            try:
+                f.result()
+            except ValueError as e:
+                assert "refusing to scatter" in str(e)
+                errs += 1
+        assert errs == 2, "coalesced batch with lying output shape must fail"
+
+
+def test_arrivals_during_inflight_call_form_one_batch():
+    # continuous batching: the accumulation window is the device's busy time
+    rt = make_runtime(delay_s=0.08)
+    mid = load(rt)
+    sizes = []
+    orig = rt.predict
+
+    def record(m, inputs, f=None):
+        sizes.append(int(np.asarray(inputs["x"]).shape[0]))
+        return orig(m, inputs, f)
+
+    rt.predict = record
+    b = MicroBatcher(rt, max_batch=64)
+
+    def one(i):
+        return float(b.predict(mid, {"x": np.array([float(i)], np.float32)})["y"][0])
+
+    with ThreadPoolExecutor(max_workers=6) as pool:
+        first = pool.submit(one, 0)         # solo; holds the device 80ms
+        time.sleep(0.02)
+        futs = [pool.submit(one, i) for i in range(1, 6)]  # all land mid-call
+        assert first.result() == 0.0
+        assert [f.result() for f in futs] == [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert sizes == [1, 5], f"expected solo then one 5-row batch, got {sizes}"
+
+
 def test_single_request_runs_solo_without_batch_overhead():
     rt = make_runtime()
     mid = load(rt)
-    b = MicroBatcher(rt, window_ms=5.0, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64)
     out = b.predict(mid, {"x": np.array([2.0], np.float32)})
     assert float(out["y"][0]) == 2.0
     assert b.batches == 0  # solo leader path

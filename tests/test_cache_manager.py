@@ -111,6 +111,69 @@ def test_load_failure_propagates_and_leaves_cache_consistent(tmp_path):
     assert runtime.is_loaded(ModelId("m", 1))
 
 
+def test_load_deadline_times_out_slow_compile(tmp_path):
+    # reference hardcodes a 10 s fetch timeout (main.go:122); here the
+    # deadline covers fetch+compile and must fail fast, releasing the
+    # singleflight while the orphaned load completes in the background
+    import time
+
+    from tfservingcache_tpu.runtime.base import LoadTimeoutError
+
+    provider = make_store(tmp_path / "store", [("m", 1, 50)])
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime(load_delay_s=0.5)
+    manager = CacheManager(provider, cache, runtime, load_timeout_s=0.1)
+    mid = ModelId("m", 1)
+    t0 = time.monotonic()
+    with pytest.raises(LoadTimeoutError, match="deadline"):
+        manager.ensure_servable(mid)
+    assert time.monotonic() - t0 < 0.4  # failed fast, not after the full load
+    # the orphaned worker finishes; the model becomes servable for later calls
+    deadline = time.monotonic() + 5.0
+    while not runtime.is_loaded(mid):
+        assert time.monotonic() < deadline, "background load never completed"
+        time.sleep(0.02)
+    manager.ensure_servable(mid)  # now a HIT, no timeout
+
+
+def test_load_deadline_times_out_slow_fetch(tmp_path):
+    from tfservingcache_tpu.cache.providers.base import ModelProvider
+    from tfservingcache_tpu.runtime.base import LoadTimeoutError
+
+    import time
+
+    class HungProvider(ModelProvider):
+        def load_model(self, name, version, dest):
+            time.sleep(10.0)
+            raise AssertionError("unreachable in test")
+
+        def model_size(self, name, version):
+            return 10
+
+        def check(self):
+            pass
+
+        def list_versions(self, name):
+            return [1]
+
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    manager = CacheManager(HungProvider(), cache, FakeRuntime(), load_timeout_s=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(LoadTimeoutError, match="fetch"):
+        manager.ensure_servable(ModelId("m", 1))
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_no_deadline_by_default(tmp_path):
+    # load_timeout_s=None runs inline: slow loads just take their time
+    provider = make_store(tmp_path / "store", [("m", 1, 50)])
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime(load_delay_s=0.15)
+    manager = CacheManager(provider, cache, runtime)
+    manager.ensure_servable(ModelId("m", 1))
+    assert runtime.is_loaded(ModelId("m", 1))
+
+
 def test_unknown_model_raises(setup):
     manager, _, _ = setup
     from tfservingcache_tpu.cache.providers.base import ModelNotFoundError

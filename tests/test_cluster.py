@@ -127,7 +127,7 @@ async def test_three_node_routed_cluster(tmp_path):
                 mock.push([info0, info1, info2])
                 await connect
                 # router colocated with node0: local short-circuit for its keys
-                routing = RoutingBackend(cluster, info0, backend0)
+                routing = RoutingBackend(cluster, {info0.ident: backend0})
                 router_rest = RestServingServer(routing, require_version=True)
                 router_grpc = GrpcServingServer(routing)
                 rr_port = await router_rest.start(0, host="127.0.0.1")
@@ -194,7 +194,7 @@ async def test_router_retries_dead_replica(tmp_path):
         await asyncio.sleep(0.05)
         mock.push([live_info, dead_info])
         await connect
-        routing = RoutingBackend(cluster, self_node, None)
+        routing = RoutingBackend(cluster)
         try:
             for _ in range(6):  # random replica start: hit dead one sometimes
                 req = sv.PredictRequest()

@@ -101,7 +101,7 @@ async def test_consul_register_heartbeat_and_poll():
     try:
         q = svc.subscribe()
         await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
-        reg = fake.registrations[svc.service_id]
+        reg = fake.registrations[svc._service_ids[0]]
         assert set(reg["Tags"]) == {"rest:8094", "grpc:8095"}
         assert reg["Check"]["TTL"] == "0.2s"
         assert reg["Check"]["DeregisterCriticalServiceAfter"] == "20s"  # 100x ttl
@@ -114,11 +114,33 @@ async def test_consul_register_heartbeat_and_poll():
         }
         await wait_for(q, lambda ns: idents(ns) == ["10.0.0.1:8094:8095", "10.0.0.2:8094:8095"])
         await asyncio.sleep(0.25)  # at least one ttl/2 beat
-        assert ("pass", f"service:{svc.service_id}") in fake.beats
+        assert ("pass", f"service:{svc._service_ids[0]}") in fake.beats
+        sid = svc._service_ids[0]
     finally:
         await svc.unregister()
         await runner.cleanup()
-    assert svc.service_id in fake.deregistered
+    assert sid in fake.deregistered
+
+
+async def test_consul_multi_registration_per_process():
+    # a host serving several chip groups registers each group endpoint as its
+    # own consul service with an independent TTL check
+    fake = FakeConsul()
+    runner, url = await serve_app(fake.app())
+    svc = ConsulDiscoveryService(url, "tpusc", ttl_s=0.2, poll_interval_s=0.05)
+    try:
+        q = svc.subscribe()
+        await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
+        await svc.register(NodeInfo("10.0.0.1", 8194, 8195), lambda: True)
+        assert len(svc._service_ids) == 2
+        await wait_for(
+            q, lambda ns: idents(ns) == ["10.0.0.1:8094:8095", "10.0.0.1:8194:8195"]
+        )
+        sids = list(svc._service_ids)
+    finally:
+        await svc.unregister()
+        await runner.cleanup()
+    assert set(sids) <= set(fake.deregistered)
 
 
 async def test_consul_unhealthy_heartbeats_fail():
@@ -270,7 +292,8 @@ async def test_etcd_register_watch_and_expiry():
     try:
         q = svc.subscribe()
         await svc.register(NodeInfo("10.0.0.1", 8094, 8095), lambda: True)
-        assert fake.kv[svc.self_key] == "10.0.0.1:8094:8095"
+        assert fake.kv[svc._self_keys[0]] == "10.0.0.1:8094:8095"
+        self_key = svc._self_keys[0]
         assert fake.lease_grants >= 1
         await wait_for(q, lambda ns: idents(ns) == ["10.0.0.1:8094:8095"])
         await wait_until(lambda: fake.watchers)  # watch stream established
@@ -285,7 +308,7 @@ async def test_etcd_register_watch_and_expiry():
     finally:
         await svc.unregister()
         await runner.cleanup()
-    assert svc.self_key not in fake.kv  # deregistered
+    assert self_key not in fake.kv  # deregistered
 
 
 async def test_etcd_heartbeat_regrants_lease():

@@ -161,6 +161,52 @@ async def test_reload_config_prefetch(tmp_path):
         await channel.close()
 
 
+async def test_reload_config_latest_and_all_policies(tmp_path):
+    # full ServableVersionPolicy parity (reference forwards the oneof to TF
+    # Serving, servingcontroller.go:159-187): latest{N}, all, and unset
+    async with single_node(
+        tmp_path,
+        families=(("half_plus_two", "m", 1), ("half_plus_two", "m", 2),
+                  ("half_plus_two", "m", 3), ("half_plus_two", "other", 7)),
+    ) as (_, gport, manager, _):
+        from tfservingcache_tpu.types import ModelId
+
+        channel = make_channel(f"127.0.0.1:{gport}")
+        stub = ServingStub(channel)
+        # latest{num_versions: 2} -> newest two versions only
+        req = sv.ReloadConfigRequest()
+        mc = req.config.model_config_list.config.add()
+        mc.name = "m"
+        mc.model_version_policy.latest.num_versions = 2
+        resp = await stub.method(MODEL_SERVICE, "HandleReloadConfigRequest")(req)
+        assert resp.status.error_code == 0
+        assert not manager.runtime.is_loaded(ModelId("m", 1))
+        assert manager.runtime.is_loaded(ModelId("m", 2))
+        assert manager.runtime.is_loaded(ModelId("m", 3))
+        # all -> every version
+        req2 = sv.ReloadConfigRequest()
+        mc2 = req2.config.model_config_list.config.add()
+        mc2.name = "m"
+        mc2.model_version_policy.all.SetInParent()
+        resp2 = await stub.method(MODEL_SERVICE, "HandleReloadConfigRequest")(req2)
+        assert resp2.status.error_code == 0
+        assert all(manager.runtime.is_loaded(ModelId("m", v)) for v in (1, 2, 3))
+        # unset policy -> latest single version
+        req3 = sv.ReloadConfigRequest()
+        req3.config.model_config_list.config.add().name = "other"
+        resp3 = await stub.method(MODEL_SERVICE, "HandleReloadConfigRequest")(req3)
+        assert resp3.status.error_code == 0
+        assert manager.runtime.is_loaded(ModelId("other", 7))
+        # unknown model -> NOT_FOUND status, not an exception
+        req4 = sv.ReloadConfigRequest()
+        mc4 = req4.config.model_config_list.config.add()
+        mc4.name = "ghost"
+        mc4.model_version_policy.all.SetInParent()
+        resp4 = await stub.method(MODEL_SERVICE, "HandleReloadConfigRequest")(req4)
+        assert resp4.status.error_code == 5
+        await channel.close()
+
+
 async def test_mnist_classify_rest_and_grpc(tmp_path):
     async with single_node(tmp_path, families=(("mnist_cnn", "mn", 1),)) as (
         rport,

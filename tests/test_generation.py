@@ -62,6 +62,52 @@ def test_sampled_generation_in_vocab_and_deterministic_per_seed():
     assert a.shape == (2, 5) and (0 <= a).all() and (a < TINY["vocab_size"]).all()
 
 
+def test_sampling_config_never_recompiles():
+    """temperature/top_k are traced, so novel sampling configs reuse ONE
+    compiled program (the round-1 static args were a compile-DoS vector on
+    the unauthenticated :generate verb — ADVICE.md)."""
+    from tfservingcache_tpu.models.generation import _generate_jit
+
+    model = build("transformer_lm", TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = np.ones((2, 4), np.int32)
+    generate(model, params, ids, max_new_tokens=4, temperature=0.0, top_k=0)
+    before = _generate_jit._cache_size()
+    for temp, k in [(0.31, 3), (0.77, 17), (1.5, 0), (0.0, 5), (2.25, 96)]:
+        out = np.asarray(
+            generate(model, params, ids, max_new_tokens=4, temperature=temp, top_k=k)
+        )
+        assert out.shape == (2, 4)
+        assert (0 <= out).all() and (out < TINY["vocab_size"]).all()
+    assert _generate_jit._cache_size() == before, "sampling config caused a recompile"
+
+
+def test_top_k_at_or_beyond_vocab_is_safe():
+    # top_k >= vocab must behave like no filtering, not crash (ADVICE.md low)
+    model = build("transformer_lm", TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = np.ones((1, 4), np.int32)
+    for k in (TINY["vocab_size"], TINY["vocab_size"] + 50, 10**9):
+        out = np.asarray(
+            generate(model, params, ids, max_new_tokens=3, temperature=0.8, top_k=k,
+                     rng=jax.random.PRNGKey(2))
+        )
+        assert out.shape == (1, 3)
+        assert (0 <= out).all() and (out < TINY["vocab_size"]).all()
+
+
+def test_greedy_via_traced_temperature_matches_argmax_semantics():
+    # temperature=0 through the traced path must still be exact greedy
+    model = build("transformer_lm", TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = np.ones((1, 5), np.int32)
+    a = np.asarray(generate(model, params, ids, max_new_tokens=4, temperature=0.0,
+                            top_k=7, rng=jax.random.PRNGKey(0)))
+    b = np.asarray(generate(model, params, ids, max_new_tokens=4, temperature=0.0,
+                            top_k=0, rng=jax.random.PRNGKey(9)))
+    assert (a == b).all()  # rng/top_k are irrelevant at temperature 0
+
+
 def test_generate_rejects_overflow_and_wrong_family():
     model = build("transformer_lm", TINY)
     params = model.init(jax.random.PRNGKey(0))
@@ -81,10 +127,22 @@ def test_runtime_generate_buckets_and_truncates(tmp_path):
         out = rt.generate(mid, np.ones((2, 5), np.int32), max_new_tokens=6)
         assert out.shape == (2, 6)  # bucketed to 8 internally, truncated back
         assert out.dtype == np.int32
+        # batch axis buckets too: B=3 pads to 4 internally, returns 3 rows —
+        # and the padded rows must not change the real rows' greedy output
+        out2 = rt.generate(mid, np.ones((2, 5), np.int32), max_new_tokens=6)
+        out3 = rt.generate(mid, np.ones((3, 5), np.int32), max_new_tokens=6)
+        assert out3.shape == (3, 6)
+        assert (out3[:2] == out2).all()
         with pytest.raises(RuntimeError_):
             rt.generate(mid, np.ones((1, 60), np.int32), max_new_tokens=10)
         with pytest.raises(RuntimeError_):
             rt.generate(mid, np.ones((3,), np.int32))  # 1-D input
+        with pytest.raises(RuntimeError_):
+            rt.generate(mid, np.ones((1, 4), np.int32), temperature=float("nan"))
+        with pytest.raises(RuntimeError_):
+            rt.generate(mid, np.ones((1, 4), np.int32), temperature=-1.0)
+        with pytest.raises(RuntimeError_):
+            rt.generate(mid, np.ones((1, 4), np.int32), top_k=-3)
     finally:
         rt.close()
 

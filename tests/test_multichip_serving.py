@@ -1,0 +1,200 @@
+"""Multi-chip SERVING end-to-end on the virtual 8-device CPU mesh: a
+TP-sharded transformer served through the real CacheManager -> runtime ->
+LocalServingBackend -> REST/router stack, and a two-chip-group CacheNode
+whose ring assigns tenants to groups (VERDICT.md round-1 item #2; SURVEY.md
+§7 step 8 — the hard part the training-shaped dryrun didn't cover)."""
+
+import asyncio
+
+import aiohttp
+import jax
+import numpy as np
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.config import Config, ServingConfig
+from tfservingcache_tpu.models.registry import build, export_artifact
+from tfservingcache_tpu.parallel.mesh import make_mesh
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import ModelId
+
+SMALL = {
+    "vocab_size": 128,
+    "d_model": 64,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 128,
+    "max_seq": 64,
+}
+
+
+async def test_sharded_predict_through_backend_matches_unsharded(tmp_path):
+    """The serving path that ships: ensure_servable with a TP mesh ->
+    TPUModelRuntime.predict -> un-pad -> REST codec, asserted equal to the
+    unsharded single-device answer."""
+    import json
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="lm", version=1, config=SMALL)
+
+    mesh = make_mesh({"model": 8})
+    rt_tp = TPUModelRuntime(ServingConfig(), mesh=mesh)
+    mgr = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache_tp"), capacity_bytes=1 << 30),
+        rt_tp,
+    )
+    backend = LocalServingBackend(mgr)
+
+    rt_1 = TPUModelRuntime(ServingConfig())
+    mgr_1 = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache_1"), capacity_bytes=1 << 30),
+        rt_1,
+    )
+
+    try:
+        ids = [[3, 1, 4, 1, 5]]
+        body = json.dumps({"inputs": {"input_ids": ids}}).encode()
+        resp = await backend.handle_rest("POST", "lm", 1, "predict", body)
+        assert resp.status == 200, resp.body
+        got = np.asarray(json.loads(resp.body)["outputs"], np.float32)
+
+        mgr_1.ensure_servable(ModelId("lm", 1))
+        want = rt_1.predict(ModelId("lm", 1), {"input_ids": np.asarray(ids, np.int32)})[
+            "logits"
+        ]
+        assert got.shape == want.shape == (1, 5, SMALL["vocab_size"])
+        # bf16 shard reductions reorder; demand tight-but-not-bitwise parity
+        np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+        corr = np.corrcoef(got.ravel(), np.asarray(want).ravel())[0, 1]
+        assert corr > 0.9999, corr
+        # params really live sharded across all 8 virtual devices
+        loaded = rt_tp._resident.get(ModelId("lm", 1))
+        wq = loaded.params["layers"][0]["attn"]["wq"]
+        assert len(wq.sharding.device_set) == 8
+        # derived output works through the sharded path too
+        resp2 = await backend.handle_rest(
+            "POST", "lm", 1, "predict",
+            json.dumps(
+                {"inputs": {"input_ids": ids}, "output_filter": ["last_token_logits"]}
+            ).encode(),
+        )
+        assert resp2.status == 200, resp2.body
+        last = np.asarray(json.loads(resp2.body)["outputs"], np.float32)
+        np.testing.assert_allclose(last, got[:, -1, :], atol=1e-5)
+    finally:
+        backend.close()
+        mgr.close()
+        mgr_1.close()
+
+
+async def test_two_group_cache_node_rings_models_to_groups(tmp_path):
+    """A CacheNode with chips_per_group=4 on 8 virtual devices serves TWO
+    ring members (group 0 and group 1), each a 4-chip TP mesh with its own
+    ports; the router hashes tenants across the groups and every request
+    returns the right answer."""
+    from tfservingcache_tpu.cluster.router import Router
+
+    store = tmp_path / "store"
+    n_tenants = 8
+    for i in range(n_tenants):
+        export_artifact(
+            "transformer_lm", str(store), name=f"t{i}", version=1, config=SMALL, seed=i
+        )
+
+    cfg = Config()
+    cfg.model_provider.type = "disk"
+    cfg.model_provider.base_dir = str(store)
+    cfg.cache.base_dir = str(tmp_path / "cache")
+    cfg.cache_node.rest_port = 0
+    cfg.cache_node.grpc_port = 0
+    cfg.proxy.rest_port = 0
+    cfg.proxy.grpc_port = 0
+    cfg.mesh.chips_per_group = 4
+    cfg.discovery.type = "static"
+    cfg.discovery.prefer_localhost = True
+
+    from tfservingcache_tpu.server import CacheNode
+
+    node = CacheNode(cfg)
+    assert len(node.groups) == 2, "8 devices / group size 4 must yield 2 groups"
+    meshes = [g.manager.runtime.mesh for g in node.groups]
+    assert all(m is not None and m.shape == {"model": 4} for m in meshes)
+    assert set(meshes[0].devices.flat).isdisjoint(set(meshes[1].devices.flat))
+
+    await node.start()
+    router = Router(cfg, node)
+    rr_port, _ = await router.start()
+    try:
+        assert router.cluster.node_count == 2  # both groups on the ring
+        served_by = {0: 0, 1: 0}
+        async with aiohttp.ClientSession() as s:
+            for i in range(n_tenants):
+                url = f"http://127.0.0.1:{rr_port}/v1/models/t{i}/versions/1:predict"
+                async with s.post(
+                    url, json={"inputs": {"input_ids": [[1, 2, 3]]}}
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                    out = np.asarray((await resp.json())["outputs"], np.float32)
+                assert out.shape == (1, 3, SMALL["vocab_size"])
+                assert np.all(np.isfinite(out))
+        for gi, g in enumerate(node.groups):
+            served_by[gi] = len(g.manager.runtime.resident_models())
+        assert sum(served_by.values()) == n_tenants
+        assert all(v > 0 for v in served_by.values()), (
+            f"ring failed to spread tenants across groups: {served_by}"
+        )
+        # parity of one tenant against an unsharded runtime
+        rt_1 = TPUModelRuntime(ServingConfig())
+        mgr_1 = CacheManager(
+            DiskModelProvider(str(store)),
+            ModelDiskCache(str(tmp_path / "cache_ref"), capacity_bytes=1 << 30),
+            rt_1,
+        )
+        try:
+            mid = ModelId("t0", 1)
+            mgr_1.ensure_servable(mid)
+            want = rt_1.predict(mid, {"input_ids": np.array([[1, 2, 3]], np.int32)})
+            owner = next(
+                g for g in node.groups
+                if mid in g.manager.runtime.resident_models()
+            )
+            got = owner.manager.runtime.predict(
+                mid, {"input_ids": np.array([[1, 2, 3]], np.int32)}
+            )
+            np.testing.assert_allclose(
+                got["logits"], want["logits"], atol=5e-2, rtol=5e-2
+            )
+        finally:
+            mgr_1.close()
+    finally:
+        await router.close()
+        await node.close()
+
+
+async def test_group_disk_eviction_unloads_every_group(tmp_path):
+    """Shared host disk cache: when an artifact is evicted from disk, EVERY
+    group runtime that has it resident must drop its executable."""
+    from tfservingcache_tpu.runtime.fake import FakeRuntime
+
+    store = tmp_path / "store"
+    d = store / "m" / "1"
+    d.mkdir(parents=True)
+    (d / "params.bin").write_bytes(b"x" * 64)
+
+    provider = DiskModelProvider(str(store))
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 20)
+    rt_a, rt_b = FakeRuntime(), FakeRuntime()
+    mgr_a = CacheManager(provider, cache, rt_a)
+    mgr_b = CacheManager(provider, cache, rt_b)
+    mid = ModelId("m", 1)
+    mgr_a.ensure_servable(mid)
+    mgr_b.ensure_servable(mid)
+    assert rt_a.is_loaded(mid) and rt_b.is_loaded(mid)
+    cache.remove(mid)
+    cache.drain_evictions()
+    assert not rt_a.is_loaded(mid) and not rt_b.is_loaded(mid)

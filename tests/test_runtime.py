@@ -60,8 +60,39 @@ def test_output_filter(runtime, tmp_path):
     assert set(out) == {"logits", "classes"} and out["logits"].shape == (2, 10)
     only = runtime.predict(model.identifier, {"image": img}, output_filter=["classes"])
     assert set(only) == {"classes"}
-    with pytest.raises(RuntimeError_, match="matches no outputs"):
+    with pytest.raises(RuntimeError_, match="unknown outputs"):
         runtime.predict(model.identifier, {"image": img}, output_filter=["nope"])
+
+
+def test_derived_last_token_logits(runtime, tmp_path):
+    """The LM warm-path fix: last_token_logits ships (B, V), sliced at the
+    last REAL position despite seq padding, and matches the full logits."""
+    tiny = {
+        "vocab_size": 97, "d_model": 48, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 96, "max_seq": 64,
+    }
+    model = make_model(tmp_path, family="transformer_lm", name="lm_last", config=tiny)
+    runtime.ensure_loaded(model)
+    ids = np.random.default_rng(0).integers(1, 97, (3, 5)).astype(np.int32)  # pads: b->4, s->8
+    full = runtime.predict(model.identifier, {"input_ids": ids})
+    last = runtime.predict(
+        model.identifier, {"input_ids": ids}, output_filter=["last_token_logits"]
+    )
+    assert set(last) == {"last_token_logits"}
+    assert last["last_token_logits"].shape == (3, 97)
+    np.testing.assert_allclose(
+        last["last_token_logits"], full["logits"][:, -1, :], atol=1e-5, rtol=1e-5
+    )
+    # advertised in the signature for metadata discovery
+    _, out_spec, _ = runtime.signature(model.identifier)
+    assert "last_token_logits" in out_spec
+    # mixed filter: concrete + derived in one request
+    both = runtime.predict(
+        model.identifier, {"input_ids": ids},
+        output_filter=["logits", "last_token_logits"],
+    )
+    assert set(both) == {"logits", "last_token_logits"}
+    assert both["logits"].shape == (3, 5, 97)  # un-padded on device
 
 
 def test_unload_and_states(runtime, tmp_path):

@@ -51,7 +51,12 @@ class ModelDiskCache:
     ) -> None:
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
-        self._user_on_evict = on_evict
+        # multiple subscribers: with several chip-group runtimes sharing one
+        # host disk cache, EVERY group must drop its executable when the
+        # artifact goes (resident => re-loadable invariant)
+        self._evict_callbacks: list[Callable[[ModelId], None]] = (
+            [on_evict] if on_evict is not None else []
+        )
         self.lru = make_lru_cache(capacity_bytes, self._evict)
         # Per-model mutexes shared by eviction and (re)load: a deferred evict
         # rmtree must not race a concurrent re-fetch writing the same path.
@@ -155,8 +160,14 @@ class ModelDiskCache:
             except OSError:
                 pass
         log.info("evicted %s from disk cache (%d bytes)", model_id, entry.size_bytes)
-        if self._user_on_evict is not None:
-            self._user_on_evict(model_id)
+        for cb in list(self._evict_callbacks):
+            try:
+                cb(model_id)
+            except Exception:  # noqa: BLE001 - one group's failure can't block others
+                log.exception("disk-evict callback failed for %s", model_id)
+
+    def add_evict_callback(self, cb: Callable[[ModelId], None]) -> None:
+        self._evict_callbacks.append(cb)
 
     def _recover_index(self) -> None:
         """Repopulate the LRU from artifacts already on disk (restart path)."""

@@ -18,11 +18,12 @@ the heart of the system. Differences by design:
 
 from __future__ import annotations
 
+import threading
 import time
 
 from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.providers.base import ModelProvider
-from tfservingcache_tpu.runtime.base import BaseRuntime
+from tfservingcache_tpu.runtime.base import BaseRuntime, LoadTimeoutError
 from tfservingcache_tpu.types import Model, ModelId
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
@@ -38,15 +39,20 @@ class CacheManager:
         disk_cache: ModelDiskCache,
         runtime: BaseRuntime,
         metrics: Metrics | None = None,
+        load_timeout_s: float | None = None,
     ) -> None:
         self.provider = provider
         self.disk_cache = disk_cache
         self.runtime = runtime
         self.metrics = metrics
+        # cold-path deadline over fetch+compile (reference: hardcoded 10 s
+        # fetch timeout, cmd/taskhandler/main.go:122). None/0 disables.
+        self.load_timeout_s = load_timeout_s or None
         # a model evicted from the disk tier must not keep serving from HBM:
         # its artifact is gone, a restart would break the invariant that
-        # resident => re-loadable
-        disk_cache._user_on_evict = self._on_disk_evict
+        # resident => re-loadable (subscribe, don't overwrite: several
+        # chip-group managers may share one host disk cache)
+        disk_cache.add_evict_callback(self._on_disk_evict)
 
     def _on_disk_evict(self, model_id: ModelId) -> None:
         self.runtime.unload(model_id)
@@ -71,6 +77,7 @@ class CacheManager:
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
             return model
 
+        deadline = t0 + self.load_timeout_s if self.load_timeout_s else None
         with TRACER.span("ensure_servable", model=str(model_id)), \
                 self.disk_cache.fetch_lock(model_id):  # per-model singleflight
             model = self.disk_cache.get(model_id)
@@ -80,12 +87,20 @@ class CacheManager:
                 else:
                     # STALE: artifact cached, executable not resident
                     log.info("stale %s: artifact cached, reloading runtime", model_id)
-                    self.runtime.ensure_loaded(model)
+                    self._with_deadline(
+                        lambda: self.runtime.ensure_loaded(model), deadline,
+                        f"reload {model_id}",
+                    )
                     hit = True
             else:
                 hit = False
-                model = self._fetch(model_id)
-                self.runtime.ensure_loaded(model)
+                model = self._with_deadline(
+                    lambda: self._fetch(model_id), deadline, f"fetch {model_id}"
+                )
+                self._with_deadline(
+                    lambda: self.runtime.ensure_loaded(model), deadline,
+                    f"load {model_id}",
+                )
             if self.metrics is not None:
                 (self.metrics.cache_hits if hit else self.metrics.cache_misses).labels(
                     label
@@ -93,6 +108,50 @@ class CacheManager:
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
                 self.metrics.disk_bytes_in_use.set(self.disk_cache.total_bytes)
             return model
+
+    def _with_deadline(self, fn, deadline: float | None, desc: str):
+        """Run ``fn`` under the shared cold-load deadline.
+
+        Python can't interrupt a blocking provider download or XLA compile
+        in-thread, so with a deadline set the work runs in a daemon worker
+        while the request thread waits with a timeout: on expiry the request
+        fails fast (LoadTimeoutError -> 504/DEADLINE_EXCEEDED) and its
+        singleflight lock is released, while the orphaned worker runs to
+        completion in the background. Its result still lands (disk index /
+        runtime state machine, which the worker advances to AVAILABLE or END
+        itself), so the spent work isn't wasted: the next request finds the
+        model warm or STALE. Without a deadline the call runs inline."""
+        if deadline is None:
+            return fn()
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise LoadTimeoutError(
+                f"{desc}: cold-load deadline ({self.load_timeout_s:.1f}s) already spent"
+            )
+        import contextvars
+
+        ctx = contextvars.copy_context()  # keep TRACER span parentage in the worker
+        box: dict = {}
+        done = threading.Event()
+
+        def work() -> None:
+            try:
+                box["value"] = ctx.run(fn)
+            except BaseException as e:  # noqa: BLE001 - re-raised in caller
+                box["error"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=work, daemon=True, name="tpusc-load-worker").start()
+        if not done.wait(remaining):
+            log.warning("%s exceeded cold-load deadline (%.1fs); request fails 504, "
+                        "work continues in background", desc, self.load_timeout_s)
+            raise LoadTimeoutError(
+                f"{desc} exceeded cold-load deadline ({self.load_timeout_s:.1f}s)"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box.get("value")
 
     def _fetch(self, model_id: ModelId) -> Model:
         """MISS path: size -> evict-to-fit -> provider fetch -> index.
@@ -129,6 +188,20 @@ class CacheManager:
         if known:
             return max(known)
         return self.provider.latest_version(name)
+
+    def available_versions(self, name: str) -> list[int]:
+        """All versions the node could serve, ascending: the provider's
+        listing, falling back to disk-cached versions when the provider can't
+        enumerate (backs ReloadConfig's latest/all version policies)."""
+        from tfservingcache_tpu.cache.providers.base import ModelNotFoundError
+
+        try:
+            return self.provider.list_versions(name)
+        except ModelNotFoundError:
+            cached = sorted(m.version for m in self.disk_cache.list_models() if m.name == name)
+            if cached:
+                return cached
+            raise
 
     def is_healthy(self) -> bool:
         """Provider + runtime probes (reference IsHealthy,

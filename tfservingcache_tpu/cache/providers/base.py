@@ -18,13 +18,21 @@ from tfservingcache_tpu.types import Model
 
 @contextmanager
 def atomic_dest(dest_dir: str) -> Iterator[str]:
-    """Stage provider writes in ``<dest>.tmp-<pid>`` and atomically rename on
-    success, so a crash mid-fetch never leaves a half-written artifact at the
-    final path (a partial tree would be recovered as a complete model after
-    restart). All providers write through this."""
-    tmp = f"{dest_dir}.tmp-{os.getpid()}"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
+    """Stage provider writes in a UNIQUE ``<dest>.tmp-<pid>-<rand>`` dir and
+    atomically rename on success, so a crash mid-fetch never leaves a
+    half-written artifact at the final path (a partial tree would be
+    recovered as a complete model after restart). All providers write
+    through this.
+
+    The random suffix matters: two fetches of the same model can overlap in
+    one process — a cold-load deadline releases the singleflight lock while
+    its orphaned worker keeps downloading, and a client retry starts a second
+    fetch (cache/manager.py _with_deadline). Per-call staging dirs keep the
+    writers fully independent; whoever finishes later wins the final rename
+    with a complete tree either way."""
+    import uuid
+
+    tmp = f"{dest_dir}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
     os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
     try:
         yield tmp
@@ -32,8 +40,16 @@ def atomic_dest(dest_dir: str) -> Iterator[str]:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
     if os.path.exists(dest_dir):
-        shutil.rmtree(dest_dir)
-    os.replace(tmp, dest_dir)
+        shutil.rmtree(dest_dir, ignore_errors=True)
+    try:
+        os.replace(tmp, dest_dir)
+    except OSError:
+        if os.path.isdir(dest_dir):
+            # a concurrent fetch of the same artifact won the rename between
+            # our rmtree and replace; its tree is complete — discard ours
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            raise
 
 
 class ProviderError(Exception):
@@ -57,9 +73,19 @@ class ModelProvider(abc.ABC):
     def check(self) -> None:
         """Health probe; raise ProviderError when the backing store is down."""
 
+    def list_versions(self, name: str) -> list[int]:
+        """All stored versions of ``name``, ascending (backs the reload-config
+        ServableVersionPolicy latest/all shapes — reference forwards the full
+        policy to TF Serving, servingcontroller.go:159-187). Providers that
+        can list versions must override this."""
+        raise ModelNotFoundError(
+            f"provider {type(self).__name__} cannot list versions for {name!r}"
+        )
+
     def latest_version(self, name: str) -> int:
         """Highest stored version of ``name`` (serves requests that omit the
-        version). Providers that can list versions must override this."""
-        raise ModelNotFoundError(
-            f"provider {type(self).__name__} cannot resolve a latest version for {name!r}"
-        )
+        version)."""
+        versions = self.list_versions(name)
+        if not versions:
+            raise ModelNotFoundError(f"no versions of model {name!r}")
+        return max(versions)

@@ -53,21 +53,22 @@ class DiskModelProvider(ModelProvider):
             size_on_disk=dir_size_bytes(dest_dir),
         )
 
-    def latest_version(self, name: str) -> int:
-        """Highest numeric version dir (used when clients omit the version)."""
+    def list_versions(self, name: str) -> list[int]:
+        """All numeric version dirs, ascending (zero-padded names collapse to
+        their numeric value, diskmodelprovider.go:46-69 semantics)."""
         model_dir = os.path.join(self.base_dir, name)
         if not os.path.isdir(model_dir):
             raise ModelNotFoundError(f"model dir not found: {model_dir}")
-        versions = []
+        versions = set()
         for entry in os.listdir(model_dir):
             try:
                 if os.path.isdir(os.path.join(model_dir, entry)):
-                    versions.append(int(entry))
+                    versions.add(int(entry))
             except ValueError:
                 continue
         if not versions:
             raise ModelNotFoundError(f"no versions of model {name!r} in {model_dir}")
-        return max(versions)
+        return sorted(versions)
 
     def model_size(self, name: str, version: int) -> int:
         return dir_size_bytes(self._find_src_path(name, version))

@@ -207,20 +207,20 @@ class ObjectStoreProvider(ModelProvider):
         objects, _ = self._list_model_objects(name, version)
         return sum(o.size for o in objects)
 
-    def latest_version(self, name: str) -> int:
+    def list_versions(self, name: str) -> list[int]:
         base = "/".join(p for p in (self.base_path, name) if p) + "/"
-        versions = []
+        versions = set()
         for _, common in self._list_all(base, delimiter="/"):
             if common is None:
                 continue
             seg = common[len(base):].strip("/")
             try:
-                versions.append(int(seg))
+                versions.add(int(seg))
             except ValueError:
                 continue
         if not versions:
             raise ModelNotFoundError(f"no versions of model {name!r} under {base!r}")
-        return max(versions)
+        return sorted(versions)
 
     def check(self) -> None:
         """Health probe = 1-key list, bounded like the reference's

@@ -37,11 +37,26 @@ class ClusterConnection:
         self.on_update: list[Callable[[list[NodeInfo]], None]] = []
 
     async def connect(
-        self, self_node: NodeInfo, is_healthy: Callable[[], bool], wait_ready_s: float = 5.0
+        self,
+        self_node: NodeInfo | list,
+        is_healthy: Callable[[], bool],
+        wait_ready_s: float = 5.0,
     ) -> None:
+        """Register this host's serving endpoint(s) and start consuming
+        membership. A host running several chip groups passes one entry per
+        group — each group is its own ring member (SURVEY.md §7 step 8).
+        Entries are NodeInfo (heartbeats driven by ``is_healthy``) or
+        ``(NodeInfo, per_group_is_healthy)`` pairs, so one sick chip group
+        drops ONLY its own ring membership, not its healthy siblings'."""
         queue = self.discovery.subscribe()
         self._task = asyncio.create_task(self._update_loop(queue))
-        await self.discovery.register(self_node, is_healthy)
+        entries = self_node if isinstance(self_node, list) else [self_node]
+        for entry in entries:
+            if isinstance(entry, tuple):
+                node, health = entry
+            else:
+                node, health = entry, is_healthy
+            await self.discovery.register(node, health)
         try:
             await asyncio.wait_for(self._first_update.wait(), wait_ready_s)
         except asyncio.TimeoutError:

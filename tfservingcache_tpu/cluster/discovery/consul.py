@@ -43,9 +43,12 @@ class ConsulDiscoveryService(DiscoveryService):
         self.service_name = service_name
         self.ttl_s = ttl_s
         self.poll_interval_s = poll_interval_s
-        self.service_id = f"{service_name}-{uuid.uuid4().hex[:12]}"
+        # one consul service id per register() call (a host may register
+        # several chip-group endpoints)
+        self._service_ids: list[str] = []
         self._session: aiohttp.ClientSession | None = None
         self._tasks: list[asyncio.Task] = []
+        self._polling = False
 
     async def _ensure_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -56,9 +59,11 @@ class ConsulDiscoveryService(DiscoveryService):
 
     async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
         session = await self._ensure_session()
+        service_id = f"{self.service_name}-{uuid.uuid4().hex[:12]}"
+        self._service_ids.append(service_id)
         body = {
             "Name": self.service_name,
-            "ID": self.service_id,
+            "ID": service_id,
             "Address": self_node.host,
             "Port": self_node.rest_port,
             # ports ride tags, reference consul.go:49-56
@@ -75,18 +80,22 @@ class ConsulDiscoveryService(DiscoveryService):
                 raise ConnectionError(
                     f"consul register failed: HTTP {resp.status}: {await resp.text()}"
                 )
-        self._tasks.append(asyncio.create_task(self._heartbeat_loop(is_healthy)))
-        self._tasks.append(asyncio.create_task(self._poll_loop()))
-        log.info("registered %s with consul at %s", self.service_id, self.base)
+        self._tasks.append(
+            asyncio.create_task(self._heartbeat_loop(service_id, is_healthy))
+        )
+        if not self._polling:
+            self._polling = True
+            self._tasks.append(asyncio.create_task(self._poll_loop()))
+        log.info("registered %s with consul at %s", service_id, self.base)
 
-    async def _heartbeat_loop(self, is_healthy: Callable[[], bool]) -> None:
+    async def _heartbeat_loop(self, service_id: str, is_healthy: Callable[[], bool]) -> None:
         """TTL check pass/fail every ttl/2 (reference consul.go:138-160)."""
         session = await self._ensure_session()
         while True:
             verb = "pass" if is_healthy() else "fail"
             try:
                 async with session.put(
-                    f"{self.base}/v1/agent/check/{verb}/service:{self.service_id}"
+                    f"{self.base}/v1/agent/check/{verb}/service:{service_id}"
                 ) as resp:
                     if resp.status != 200:
                         log.warning("consul heartbeat %s: HTTP %d", verb, resp.status)
@@ -152,14 +161,17 @@ class ConsulDiscoveryService(DiscoveryService):
         for t in self._tasks:
             t.cancel()
         self._tasks.clear()
+        self._polling = False
         if self._session is not None and not self._session.closed:
-            try:
-                async with self._session.put(
-                    f"{self.base}/v1/agent/service/deregister/{self.service_id}"
-                ) as resp:
-                    if resp.status != 200:
-                        log.warning("consul deregister: HTTP %d", resp.status)
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                log.warning("consul deregister failed: %s", e)
+            for service_id in self._service_ids:
+                try:
+                    async with self._session.put(
+                        f"{self.base}/v1/agent/service/deregister/{service_id}"
+                    ) as resp:
+                        if resp.status != 200:
+                            log.warning("consul deregister: HTTP %d", resp.status)
+                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    log.warning("consul deregister failed: %s", e)
+            self._service_ids.clear()
             await self._session.close()
             self._session = None

@@ -56,9 +56,12 @@ class EtcdDiscoveryService(DiscoveryService):
         self.base = (address or "http://127.0.0.1:2379").rstrip("/")
         self.prefix = f"/service/{service_name}/"
         self.ttl_s = max(ttl_s, 1.0)
-        self.self_key = f"{self.prefix}{uuid.uuid4().hex}"
+        # one leased key per register() call: a host registering several
+        # chip-group endpoints gets one independently-expiring key each
+        self._self_keys: list[str] = []
         self._session: aiohttp.ClientSession | None = None
         self._tasks: list[asyncio.Task] = []
+        self._watching = False
         self._nodes: dict[str, NodeInfo] = {}  # key -> node (delta tracking)
 
     async def _ensure_session(self) -> aiohttp.ClientSession:
@@ -78,22 +81,30 @@ class EtcdDiscoveryService(DiscoveryService):
             return json.loads(text)
 
     async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
-        await self._heartbeat_once(self_node.ident)  # fail fast if etcd is down
-        self._tasks.append(asyncio.create_task(self._heartbeat_loop(self_node, is_healthy)))
-        self._tasks.append(asyncio.create_task(self._watch_loop()))
-        log.info("registered %s in etcd at %s", self.self_key, self.base)
+        key = f"{self.prefix}{uuid.uuid4().hex}"
+        self._self_keys.append(key)
+        await self._heartbeat_once(key, self_node.ident)  # fail fast if etcd is down
+        self._tasks.append(
+            asyncio.create_task(self._heartbeat_loop(key, self_node, is_healthy))
+        )
+        if not self._watching:
+            self._watching = True
+            self._tasks.append(asyncio.create_task(self._watch_loop()))
+        log.info("registered %s in etcd at %s", key, self.base)
 
-    async def _heartbeat_once(self, ident: str) -> None:
+    async def _heartbeat_once(self, key: str, ident: str) -> None:
         """Grant a fresh ttl lease + put our key under it (reference
         etcd.go:134-148 does exactly this per beat: liveness = lease expiry)."""
         lease = await self._post("/v3/lease/grant", {"TTL": int(self.ttl_s)})
         lease_id = lease.get("ID")
         await self._post(
             "/v3/kv/put",
-            {"key": _b64(self.self_key), "value": _b64(ident), "lease": lease_id},
+            {"key": _b64(key), "value": _b64(ident), "lease": lease_id},
         )
 
-    async def _heartbeat_loop(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+    async def _heartbeat_loop(
+        self, key: str, self_node: NodeInfo, is_healthy: Callable[[], bool]
+    ) -> None:
         while True:
             await asyncio.sleep(self.ttl_s / 2)
             # an unhealthy node skips the beat; its lease expires and the ring
@@ -103,7 +114,7 @@ class EtcdDiscoveryService(DiscoveryService):
                 log.warning("skipping etcd heartbeat: node unhealthy")
                 continue
             try:
-                await self._heartbeat_once(self_node.ident)
+                await self._heartbeat_once(key, self_node.ident)
             except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
                 # ValueError covers a gateway answering 200 with a non-JSON
                 # body — must not kill the heartbeat task (lease would expire
@@ -170,10 +181,13 @@ class EtcdDiscoveryService(DiscoveryService):
         for t in self._tasks:
             t.cancel()
         self._tasks.clear()
+        self._watching = False
         if self._session is not None and not self._session.closed:
-            try:
-                await self._post("/v3/kv/deleterange", {"key": _b64(self.self_key)})
-            except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError) as e:
-                log.warning("etcd deregister failed: %s", e)
+            for key in self._self_keys:
+                try:
+                    await self._post("/v3/kv/deleterange", {"key": _b64(key)})
+                except (ConnectionError, aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    log.warning("etcd deregister failed: %s", e)
+            self._self_keys.clear()
             await self._session.close()
             self._session = None

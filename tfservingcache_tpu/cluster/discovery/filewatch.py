@@ -25,7 +25,9 @@ class FileDiscoveryService(DiscoveryService):
         self.path = path
         self.poll_interval_s = poll_interval_s
         self._task: asyncio.Task | None = None
-        self._self_ident: str | None = None
+        # every register() call adds one ident (a host may register several
+        # chip-group endpoints); the poll loop re-asserts all of them
+        self._self_idents: list[str] = []
 
     def _read(self) -> list[str]:
         try:
@@ -45,11 +47,12 @@ class FileDiscoveryService(DiscoveryService):
         os.replace(tmp, self.path)
 
     async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
-        self._self_ident = self_node.ident
+        self._self_idents.append(self_node.ident)
         idents = self._read()
         if self_node.ident not in idents:
             self._write(idents + [self_node.ident])
-        self._task = asyncio.create_task(self._poll_loop())
+        if self._task is None:
+            self._task = asyncio.create_task(self._poll_loop())
 
     async def _poll_loop(self) -> None:
         last: list[str] | None = None
@@ -58,9 +61,10 @@ class FileDiscoveryService(DiscoveryService):
             # Re-assert our own membership: two nodes registering at once can
             # clobber each other's unlocked read-modify-write; converge within
             # one poll instead of staying absent forever.
-            if self._self_ident and self._self_ident not in idents:
+            missing = [i for i in self._self_idents if i not in idents]
+            if missing:
                 try:
-                    self._write(idents + [self._self_ident])
+                    self._write(idents + missing)
                     idents = self._read()
                 except OSError as e:
                     log.warning("could not re-register in %s: %s", self.path, e)
@@ -79,9 +83,11 @@ class FileDiscoveryService(DiscoveryService):
         if self._task is not None:
             self._task.cancel()
             self._task = None
-        if self._self_ident:
-            idents = [i for i in self._read() if i != self._self_ident]
+        if self._self_idents:
+            mine = set(self._self_idents)
+            idents = [i for i in self._read() if i not in mine]
             try:
                 self._write(idents)
             except OSError as e:
                 log.warning("could not deregister from %s: %s", self.path, e)
+            self._self_idents.clear()

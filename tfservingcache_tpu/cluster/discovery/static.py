@@ -14,12 +14,16 @@ class StaticDiscoveryService(DiscoveryService):
     def __init__(self, nodes: list[str]) -> None:
         super().__init__()
         self.nodes = [NodeInfo.from_ident(n) for n in nodes]
+        # accumulate across register() calls: a host adds one ring member per
+        # local chip group, and each registration must keep the earlier ones
+        self._registered: list[NodeInfo] = []
 
     async def register(self, self_node: NodeInfo, is_healthy: Callable[[], bool]) -> None:
+        if all(n.ident != self_node.ident for n in self._registered):
+            self._registered.append(self_node)
         nodes = list(self.nodes)
-        if all(n.ident != self_node.ident for n in nodes):
-            nodes.append(self_node)
+        nodes.extend(n for n in self._registered if all(m.ident != n.ident for m in nodes))
         self._publish(nodes)
 
     async def unregister(self) -> None:
-        pass
+        self._registered.clear()

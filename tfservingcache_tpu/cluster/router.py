@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+from typing import Mapping
 
 import aiohttp
 import grpc
@@ -74,19 +75,22 @@ class PeerPool:
 
 class RoutingBackend(ServingBackend):
     """ServingBackend that forwards to hash-owned peers (or serves locally
-    when this node owns the key)."""
+    when one of this host's chip groups owns the key).
+
+    ``local_backends`` maps ring-member ident -> in-process backend for every
+    group this host serves; a request whose hash lands on one of them
+    short-circuits straight to that group's backend instead of re-entering
+    through localhost."""
 
     def __init__(
         self,
         cluster: ClusterConnection,
-        self_node: NodeInfo,
-        local_backend: ServingBackend | None,
+        local_backends: Mapping[str, ServingBackend] | None = None,
         max_message_bytes: int = 16 << 20,
         retries: int = 2,
     ) -> None:
         self.cluster = cluster
-        self.self_node = self_node
-        self.local_backend = local_backend
+        self.local_backends: dict[str, ServingBackend] = dict(local_backends or {})
         self.pool = PeerPool(max_message_bytes)
         self.retries = retries
         self._http: aiohttp.ClientSession | None = None
@@ -109,20 +113,18 @@ class RoutingBackend(ServingBackend):
         start = random.randrange(len(nodes))
         return nodes[start:] + nodes[:start]
 
-    def _is_self(self, node: NodeInfo) -> bool:
-        return node.ident == self.self_node.ident
-
     async def _forward_grpc(self, service: str, method: str, name: str, version, request):
         last_err: Exception | None = None
         for attempt, node in enumerate(self._candidates(name, version)[: self.retries + 1]):
-            if self._is_self(node) and self.local_backend is not None:
+            local = self.local_backends.get(node.ident)
+            if local is not None:
                 fn = {
-                    (PREDICTION_SERVICE, "Predict"): self.local_backend.predict,
-                    (PREDICTION_SERVICE, "Classify"): self.local_backend.classify,
-                    (PREDICTION_SERVICE, "Regress"): self.local_backend.regress,
-                    (PREDICTION_SERVICE, "GetModelMetadata"): self.local_backend.get_model_metadata,
-                    (MODEL_SERVICE, "GetModelStatus"): self.local_backend.get_model_status,
-                    (SESSION_SERVICE, "SessionRun"): self.local_backend.session_run,
+                    (PREDICTION_SERVICE, "Predict"): local.predict,
+                    (PREDICTION_SERVICE, "Classify"): local.classify,
+                    (PREDICTION_SERVICE, "Regress"): local.regress,
+                    (PREDICTION_SERVICE, "GetModelMetadata"): local.get_model_metadata,
+                    (MODEL_SERVICE, "GetModelStatus"): local.get_model_status,
+                    (SESSION_SERVICE, "SessionRun"): local.session_run,
                 }[(service, method)]
                 return await fn(request)
             try:
@@ -197,10 +199,9 @@ class RoutingBackend(ServingBackend):
     ) -> RestResponse:
         last_err: Exception | None = None
         for node in self._candidates(model_name, version)[: self.retries + 1]:
-            if self._is_self(node) and self.local_backend is not None:
-                return await self.local_backend.handle_rest(
-                    method, model_name, version, verb, body
-                )
+            local = self.local_backends.get(node.ident)
+            if local is not None:
+                return await local.handle_rest(method, model_name, version, verb, body)
             url = f"http://{node.host}:{node.rest_port}/v1/models/{model_name}"
             if version is not None:
                 url += f"/versions/{version}"
@@ -242,11 +243,25 @@ class Router:
         self.discovery = create_discovery(cfg.discovery)
         self.cluster = ClusterConnection(self.discovery, cfg.proxy.replicas_per_model)
         host = "127.0.0.1" if cfg.discovery.prefer_localhost else outbound_ip()
-        self.self_node = NodeInfo(host, cfg.cache_node.rest_port, cfg.cache_node.grpc_port)
+        # one ring member per local chip group (each group has its own ports;
+        # construct the Router AFTER node.start() so ports are bound)
+        if node is not None:
+            self.self_nodes = [
+                NodeInfo(host, g.rest_port or cfg.cache_node.rest_port + g.index,
+                         g.grpc_port or cfg.cache_node.grpc_port + g.index)
+                for g in node.groups
+            ]
+            local_backends = {
+                n.ident: g.backend for n, g in zip(self.self_nodes, node.groups)
+            }
+        else:
+            self.self_nodes = [
+                NodeInfo(host, cfg.cache_node.rest_port, cfg.cache_node.grpc_port)
+            ]
+            local_backends = {}
         self.backend = RoutingBackend(
             self.cluster,
-            self.self_node,
-            node.backend if node is not None else None,
+            local_backends,
             cfg.proxy.grpc_max_message_bytes,
         )
         metrics = node.metrics if node is not None else None
@@ -257,16 +272,22 @@ class Router:
         self._health_task: asyncio.Task | None = None
 
     async def start(self) -> tuple[int, int]:
-        await self.cluster.connect(
-            self.self_node,
-            (self.node.is_healthy if self.node is not None else lambda: True),
-        )
+        # per-group health: a sick chip group drops only its own membership
+        if self.node is not None:
+            entries = [
+                (n, g.manager.is_healthy)
+                for n, g in zip(self.self_nodes, self.node.groups)
+            ]
+        else:
+            entries = list(self.self_nodes)
+        await self.cluster.connect(entries, lambda: True)
         rest_port = await self.rest.start(self.cfg.proxy.rest_port)
         grpc_port = await self.grpc.start(self.cfg.proxy.grpc_port)
         self._health_task = asyncio.create_task(self._health_loop())
         log.info(
-            "router up: REST :%d gRPC :%d as %s (%d nodes)",
-            rest_port, grpc_port, self.self_node.ident, self.cluster.node_count,
+            "router up: REST :%d gRPC :%d as %s (%d ring members)",
+            rest_port, grpc_port,
+            ",".join(n.ident for n in self.self_nodes), self.cluster.node_count,
         )
         return rest_port, grpc_port
 

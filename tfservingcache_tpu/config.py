@@ -32,13 +32,17 @@ class ServingConfig:
     hbm_capacity_bytes: int = 8 << 30      # HBM byte budget for pinned params
     warmup: bool = True                    # run one predict to pin+compile on load
     compile_cache_dir: str = ""            # persistent XLA compile cache ("" = off)
-    load_timeout_s: float = 30.0           # cold-load deadline (reference: 10s, main.go:122)
+    # cold-load (fetch+compile) deadline; 0 disables. The reference hardcodes
+    # a 10 s fetch timeout (main.go:122); XLA first-compiles can take longer,
+    # so the default is looser. Enforced by CacheManager.ensure_servable.
+    load_timeout_s: float = 30.0
     platform: str = ""                     # "" = default jax backend; "cpu" forces CPU
-    donate_on_evict: bool = True
     # adaptive micro-batching (TF Serving --enable_batching equivalent,
     # in-process now): 0 disables; concurrent same-shape requests within the
-    # window coalesce into one device call
-    batch_window_ms: float = 0.0
+    # window coalesce into one device call. Default 2 ms: well under a cold
+    # client's perception, long enough to coalesce concurrent warm traffic
+    # into one MXU dispatch (bench.py records QPS batcher on vs off).
+    batch_window_ms: float = 2.0
     batch_max_size: int = 64
 
 

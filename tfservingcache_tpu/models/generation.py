@@ -40,31 +40,43 @@ def init_cache(cfg: dict, batch: int, max_len: int) -> dict:
     }
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
-    """logits (B, V) -> token ids (B,). temperature==0 is argmax."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+def _sample(logits, rng, temperature, top_k):
+    """logits (B, V) -> token ids (B,).
+
+    ``temperature`` and ``top_k`` are TRACED scalars, not compile-time
+    constants: both arrive straight from the unauthenticated ``:generate``
+    request body, and a static argname would mint (and cache forever) a fresh
+    XLA compile of the whole prefill+scan program per novel value — a
+    compile-DoS vector. One compiled program now serves every sampling
+    config: temperature<=0 selects greedy, top_k<=0 (or >= vocab) disables
+    top-k filtering, all via in-graph selects.
+    """
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, v)
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = sorted_desc[:, jnp.clip(k - 1, 0, v - 1)][:, None]
+    thresh = jnp.where((k > 0) & (k < v), kth, -jnp.inf)
+    filt = jnp.where(logits < thresh, -1e30, logits)
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    sampled = jax.random.categorical(rng, filt / temp, axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.asarray(temperature, jnp.float32) <= 0.0, greedy, sampled)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg_key", "max_new_tokens", "temperature", "top_k"),
+    static_argnames=("cfg_key", "max_new_tokens"),
 )
 def _generate_jit(
     params,
     input_ids,
     prompt_len,
     rng,
+    temperature,
+    top_k,
     *,
     cfg_key,
     max_new_tokens: int,
-    temperature: float,
-    top_k: int,
 ):
     cfg = dict(cfg_key)
     b, s_max = input_ids.shape
@@ -130,20 +142,21 @@ def _forward_cached_dyn(params, input_ids, cache, start_pos, cfg):
         new_k.append(k_cache)
         new_v.append(v_cache)
 
-        # per-example visibility: key pos <= query pos
+        # per-example visibility: key pos <= query pos. GQA grouped-K/V form:
+        # query heads fold into (kv_head, group) so the cache is read as-is,
+        # never repeated up to n_heads (the repeat would materialize
+        # group x cache bytes every step at exactly the scale GQA exists for)
         d = q.shape[-1]
-        kk = k_cache
-        vv = v_cache
-        if n_kv != n_heads:
-            kk = jnp.repeat(kk, n_heads // n_kv, axis=1)
-            vv = jnp.repeat(vv, n_heads // n_kv, axis=1)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), kk.astype(jnp.float32))
+        group = n_heads // n_kv
+        qg = q.reshape(b, n_kv, group, s_len, d).astype(jnp.float32)
+        s = jnp.einsum("bkgqd,bkld->bkgql", qg, k_cache.astype(jnp.float32))
         s = s / math.sqrt(d)
-        k_pos = jnp.arange(kk.shape[2])
+        k_pos = jnp.arange(k_cache.shape[2])
         mask = k_pos[None, None, :] <= positions[:, :, None]      # (B, S, max_len)
-        s = jnp.where(mask[:, None], s, -1e30)
+        s = jnp.where(mask[:, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32)).astype(q.dtype)
+        out = jnp.einsum("bkgql,bkld->bkgqd", p, v_cache.astype(jnp.float32))
+        out = out.reshape(b, n_heads, s_len, d).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, s_len, cfg["d_model"])
         x = x + out @ attn["wo"]
         mlp = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["mlp"])
@@ -184,8 +197,6 @@ def generate(
     """
     if model_def.family != "transformer_lm":
         raise ValueError(f"generation supports transformer_lm, not {model_def.family!r}")
-    import numpy as np
-
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s = input_ids.shape
     if prompt_lengths is None:
@@ -205,8 +216,8 @@ def generate(
         input_ids,
         prompt_lengths,
         rng,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
         cfg_key=cfg_key,
         max_new_tokens=max_new_tokens,
-        temperature=temperature,
-        top_k=top_k,
     )

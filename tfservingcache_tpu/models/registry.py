@@ -79,6 +79,14 @@ class ModelDef:
     axis_caps: dict[str, int] = field(default_factory=dict)
     # loss(params, inputs, targets) for families that support training steps
     loss: Callable[..., Any] | None = None
+    # optional derived outputs computed OUTSIDE the jitted apply, on device,
+    # from (device_outputs, dyn_sizes): name -> (fn, spec). Lets a client
+    # request e.g. "last_token_logits" so predict ships a (B, V) slice
+    # instead of the full (B, S, V) logits to host (VERDICT.md weak #4).
+    # Only materialized when named in the request's output_filter.
+    derived_outputs: dict[str, tuple[Callable[..., Any], TensorSpec]] = field(
+        default_factory=dict
+    )
 
 
 _REGISTRY: dict[str, Callable[[dict[str, Any]], ModelDef]] = {}

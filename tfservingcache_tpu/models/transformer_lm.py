@@ -79,9 +79,8 @@ def _attention_block(params: dict, x: jax.Array, cfg: dict) -> jax.Array:
     positions = jnp.arange(s)
     q = _rope(q, positions, cfg["rope_theta"])
     k = _rope(k, positions, cfg["rope_theta"])
-    if n_kv != n_heads:  # GQA: repeat KV groups up to query heads
-        k = jnp.repeat(k, n_heads // n_kv, axis=1)
-        v = jnp.repeat(v, n_heads // n_kv, axis=1)
+    # GQA handled inside attention (grouped K/V, never materialized via
+    # repeat — that would negate GQA's HBM saving at llama-7b scale)
     out = attention(q, k, v, causal=True)                               # (b,h,s,hd)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, d_model)
     return out @ params["wo"]
@@ -178,6 +177,16 @@ def build(config: dict) -> ModelDef:
         r".*ln.*": (None,),
     }
 
+    def last_token_logits(outputs, dyn_sizes):
+        """Device-side slice at the last REAL position (runtime pads seq to a
+        bucket, so -1 would land on padding). Ships (B, V) to host instead of
+        (B, S, V) — the LM warm-path fix. Rows share one true length; ragged
+        prompts belong to :generate, which tracks per-row lengths."""
+        logits = outputs["logits"]
+        s = dyn_sizes.get("seq", logits.shape[1])
+        b = dyn_sizes.get("batch", logits.shape[0])
+        return logits[:b, s - 1, :]
+
     return ModelDef(
         family="transformer_lm",
         config=cfg,
@@ -187,4 +196,10 @@ def build(config: dict) -> ModelDef:
         output_spec={"logits": TensorSpec("float32", ("batch", "seq", cfg["vocab_size"]))},
         partition_rules=partition_rules,
         loss=loss,
+        derived_outputs={
+            "last_token_logits": (
+                last_token_logits,
+                TensorSpec("float32", ("batch", cfg["vocab_size"])),
+            )
+        },
     )

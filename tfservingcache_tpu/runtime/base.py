@@ -31,6 +31,14 @@ class ModelNotLoadedError(RuntimeError_):
     pass
 
 
+class LoadTimeoutError(RuntimeError_):
+    """Cold-load deadline exceeded (fetch and/or compile overran
+    ServingConfig.load_timeout_s). The reference hardcodes a 10 s model-fetch
+    timeout (cmd/taskhandler/main.go:122) used as the AVAILABLE-poll deadline
+    (cachemanager.go:176-193); here it bounds the whole fetch+compile path.
+    Maps to HTTP 504 / gRPC DEADLINE_EXCEEDED at the protocol layer."""
+
+
 class BaseRuntime(abc.ABC):
     def __init__(self) -> None:
         self._states: dict[ModelId, ModelState] = {}

@@ -1,4 +1,4 @@
-"""Adaptive micro-batching for the in-process runtime.
+"""Continuous (pipelined) micro-batching for the in-process runtime.
 
 Reference-parity rationale: the reference delegates request batching to TF
 Serving's ``--enable_batching`` (the sidecar never sees tensors); with
@@ -7,13 +7,21 @@ batched MXU dispatch amortizes per-call host->device overhead — the dominant
 warm-path cost for small models — and a power-of-two padded batch keeps the
 jit cache small (runtime._pad_to_bucket already buckets the batch axis).
 
-Leader/follower design: concurrent ``predict`` calls for the same
-(model, non-batch shape, filter) key concatenate along the named "batch"
-axis. The first arrival becomes the leader, waits up to ``window_ms``
-(cut short when ``max_batch`` rows accumulate), runs ONE runtime.predict,
-and splits the outputs back by each caller's row count. Calls are
-thread-blocking by design — they arrive on the protocol backend's executor
-threads (protocol/local_backend.py), never on the event loop.
+Continuous-batching design (no timed window): batches for one
+(model, non-batch shape, filter) key are serialized on a per-key gate. The
+first arrival becomes the leader of the next batch and acquires the gate;
+while a previous batch occupies the device, later arrivals keep joining the
+leader's pending batch, and the moment the gate frees the batch closes and
+runs as ONE runtime.predict, outputs split back by each caller's row count.
+The accumulation window is therefore exactly the device's own busy time:
+
+  - strictly sequential traffic acquires an uncontended gate and runs
+    immediately — ZERO added latency, which is why batching defaults on;
+  - saturating traffic coalesces into device-call-sized batches without any
+    window-length tuning (the classic latency/throughput knob dissolves).
+
+Calls are thread-blocking by design — they arrive on the protocol backend's
+executor threads (protocol/local_backend.py), never on the event loop.
 
 Models whose inputs have no named "batch" axis fall through unbatched.
 """
@@ -48,24 +56,24 @@ class _Pending:
     slots: list[_Slot] = field(default_factory=list)
     rows: int = 0
     closed: bool = False                  # no further joiners
-    full: threading.Event = field(default_factory=threading.Event)
 
 
 class MicroBatcher:
     def __init__(
         self,
         runtime: BaseRuntime,
-        window_ms: float = 2.0,
         max_batch: int = 64,
         wait_timeout_s: float = 600.0,
     ) -> None:
         self.runtime = runtime
-        self.window_s = window_ms / 1e3
         self.max_batch = max_batch
         # generous: a follower may sit behind the leader's cold jit compile
         self.wait_timeout_s = wait_timeout_s
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Pending] = {}
+        # per-key device gates: serialize batches so arrivals during an
+        # in-flight call accumulate into the next batch
+        self._gates: dict[tuple, threading.Lock] = {}
         # signature() results are static per loaded model — cache the derived
         # axis maps so the hot path doesn't rebuild spec dicts per request
         self._axes_cache: dict[ModelId, dict[str, int] | None] = {}
@@ -135,6 +143,19 @@ class MicroBatcher:
             sig.append((name, str(arr.dtype), rest))
         return (model_id, tuple(sig), tuple(output_filter or ()))
 
+    def _gate(self, key: tuple) -> threading.Lock:
+        with self._lock:
+            gate = self._gates.get(key)
+            if gate is None:
+                if len(self._gates) > 4096:
+                    # bound growth across tenants/shapes; losing a gate only
+                    # costs coalescing opportunity, never correctness
+                    self._gates = {
+                        k: g for k, g in self._gates.items() if g.locked()
+                    }
+                gate = self._gates.setdefault(key, threading.Lock())
+            return gate
+
     # -- core ---------------------------------------------------------------
     def predict(
         self,
@@ -156,11 +177,10 @@ class MicroBatcher:
         with self._lock:
             pend = self._pending.get(key)
             if pend is not None and pend.rows + rows > self.max_batch:
-                # max_batch is a hard cap: close the full batch for its
-                # leader and start a fresh one with this request
+                # max_batch is a hard cap: the full batch keeps its leader,
+                # this request starts (and leads) a fresh one
                 pend.closed = True
                 self._pending.pop(key, None)
-                pend.full.set()
                 pend = None
             leader = pend is None
             if leader:
@@ -171,7 +191,6 @@ class MicroBatcher:
             if pend.rows >= self.max_batch:
                 pend.closed = True
                 self._pending.pop(key, None)
-                pend.full.set()
 
         if not leader:
             if not slot.done.wait(self.wait_timeout_s):
@@ -181,48 +200,56 @@ class MicroBatcher:
             assert slot.result is not None
             return slot.result
 
-        # leader: give followers the window, then take the batch private
-        pend.full.wait(self.window_s)
-        with self._lock:
-            if not pend.closed:
-                pend.closed = True
-                self._pending.pop(key, None)
-        slots = pend.slots
-
-        try:
-            if len(slots) == 1:
-                out = self.runtime.predict(model_id, slot.inputs, output_filter)
-                slot.result = out
-                return out
-            with TRACER.span(
-                "microbatch", model=str(model_id), requests=len(slots), rows=pend.rows
-            ):
-                cat = {
-                    name: np.concatenate(
-                        [np.asarray(s.inputs[name]) for s in slots], axis=axes[name]
-                    )
-                    for name in slots[0].inputs
-                }
-                out = self.runtime.predict(model_id, cat, output_filter)
-                self.batches += 1
-                self.batched_requests += len(slots)
-                self._scatter(model_id, slots, out)
-            assert slot.result is not None
-            return slot.result
-        except BaseException as e:
-            for s in slots:
-                if s is not slot and s.result is None and s.error is None:
-                    s.error = e
-                    s.done.set()
-            raise
-        finally:
-            for s in slots:
-                if s is not slot:
-                    s.done.set()
+        # Leader: acquire the per-key gate. If a previous batch is on the
+        # device this blocks, and every arrival in the meantime joins OUR
+        # pend — the accumulation window IS the device's busy time. On an
+        # idle gate we pass straight through: no timed wait, no added
+        # latency for sequential traffic.
+        with self._gate(key):
+            with self._lock:
+                if not pend.closed:
+                    pend.closed = True
+                    self._pending.pop(key, None)
+            slots = pend.slots
+            try:
+                if len(slots) == 1:
+                    out = self.runtime.predict(model_id, slot.inputs, output_filter)
+                    slot.result = out
+                    return out
+                with TRACER.span(
+                    "microbatch", model=str(model_id), requests=len(slots), rows=pend.rows
+                ):
+                    cat = {
+                        name: np.concatenate(
+                            [np.asarray(s.inputs[name]) for s in slots], axis=axes[name]
+                        )
+                        for name in slots[0].inputs
+                    }
+                    out = self.runtime.predict(model_id, cat, output_filter)
+                    self.batches += 1
+                    self.batched_requests += len(slots)
+                    self._scatter(model_id, slots, out)
+                assert slot.result is not None
+                return slot.result
+            except BaseException as e:
+                for s in slots:
+                    if s is not slot and s.result is None and s.error is None:
+                        s.error = e
+                        s.done.set()
+                raise
+            finally:
+                for s in slots:
+                    if s is not slot:
+                        s.done.set()
 
     def _scatter(self, model_id: ModelId, slots: list[_Slot], out: dict[str, np.ndarray]) -> None:
-        """Split batched outputs back per caller by row ranges; outputs with
-        no named "batch" axis replicate to every caller."""
+        """Split batched outputs back per caller by row ranges.
+
+        `_batch_axes` guarantees every output of a batchable model declares a
+        batch axis, so a missing axis or a batch-dim length that disagrees
+        with the total row count means the model's spec lies about its actual
+        output shape. That MUST fail the whole batch: silently handing each
+        caller the full concatenated array would leak other callers' rows."""
         with self._lock:
             out_axes = dict(self._out_axes_cache.get(model_id, {}))
         offsets = []
@@ -231,13 +258,19 @@ class MicroBatcher:
             offsets.append((start, start + s.rows))
             start += s.rows
 
+        for name, arr in out.items():
+            ax = out_axes.get(name)
+            a = np.asarray(arr)
+            if ax is None or a.ndim <= ax or a.shape[ax] != start:
+                raise ValueError(
+                    f"batched output {name!r} of {model_id} has shape {a.shape}, "
+                    f"expected batch axis {ax} of length {start}; refusing to "
+                    f"scatter (would leak rows across requests)"
+                )
+
         for i, s in enumerate(slots):
             lo, hi = offsets[i]
-            result: dict[str, np.ndarray] = {}
-            for name, arr in out.items():
-                ax = out_axes.get(name)
-                if ax is not None and np.asarray(arr).ndim > ax and arr.shape[ax] == start:
-                    result[name] = np.take(arr, range(lo, hi), axis=ax)
-                else:
-                    result[name] = arr
-            s.result = result
+            s.result = {
+                name: np.take(arr, range(lo, hi), axis=out_axes[name])
+                for name, arr in out.items()
+            }

@@ -68,6 +68,7 @@ class TPUModelRuntime(BaseRuntime):
         cfg: ServingConfig | None = None,
         metrics: Metrics | None = None,
         mesh: Any | None = None,
+        group: int = 0,
     ) -> None:
         super().__init__()
         import jax
@@ -75,6 +76,7 @@ class TPUModelRuntime(BaseRuntime):
         self.cfg = cfg or ServingConfig()
         self.metrics = metrics
         self.mesh = mesh  # jax.sharding.Mesh for multi-chip models (parallel/)
+        self.group = group  # chip-group index on this host (metrics label)
         if self.cfg.compile_cache_dir:
             # persistent XLA compile cache: restart != recompile-the-world
             # (SURVEY.md §5 checkpoint/resume note)
@@ -220,30 +222,40 @@ class TPUModelRuntime(BaseRuntime):
             raise RuntimeError_(f"unknown inputs {sorted(unknown)} for {model_id}")
 
         dyn_sizes, padded = self._pad_to_bucket(spec, inputs, loaded.model_def.axis_caps)
-        with TRACER.span("infer", model=str(model_id)):
-            out = loaded.jitted(loaded.params, padded)
-            out = jax.device_get(out)
         out_spec = loaded.model_def.output_spec
-        result: dict[str, np.ndarray] = {}
-        for name, arr in out.items():
-            if output_filter and name not in output_filter:
-                continue
-            arr = np.asarray(arr)
-            # un-pad along every named dynamic axis of the output spec using
-            # the sizes recorded from the inputs; fixed-shape outputs pass
-            # through whole
-            ospec = out_spec.get(name)
-            if ospec is not None and dyn_sizes:
-                for axis, axis_name in ospec.dynamic_axes():
-                    true = dyn_sizes.get(axis_name)
-                    if true is not None and arr.ndim > axis and arr.shape[axis] > true:
-                        arr = np.take(arr, range(true), axis=axis)
-            result[name] = arr
-        if output_filter and not result:
+        derived = loaded.model_def.derived_outputs
+        names = list(output_filter) if output_filter else list(out_spec)
+        unknown_out = [n for n in names if n not in out_spec and n not in derived]
+        if unknown_out:
             raise RuntimeError_(
-                f"output_filter {output_filter} matches no outputs of {model_id}"
+                f"output_filter names unknown outputs {unknown_out} for {model_id} "
+                f"(available: {sorted(out_spec) + sorted(derived)})"
             )
-        return result
+        with TRACER.span("infer", model=str(model_id)):
+            dev_out = loaded.jitted(loaded.params, padded)
+            # select + un-pad ON DEVICE so device_get ships only the bytes
+            # the caller asked for — for an LM, last_token_logits transfers
+            # (B, V) instead of the padded (B', S', V) logits tensor
+            selected: dict[str, Any] = {}
+            for name in names:
+                if name in derived:
+                    fn, _dspec = derived[name]
+                    selected[name] = fn(dev_out, dyn_sizes)
+                    continue
+                arr = dev_out[name]
+                ospec = out_spec[name]
+                if dyn_sizes:
+                    for axis, axis_name in ospec.dynamic_axes():
+                        true = dyn_sizes.get(axis_name)
+                        if (
+                            true is not None
+                            and getattr(arr, "ndim", 0) > axis
+                            and arr.shape[axis] > true
+                        ):
+                            arr = jax.lax.slice_in_dim(arr, 0, true, axis=axis)
+                selected[name] = arr
+            out = jax.device_get(selected)
+        return {name: np.asarray(arr) for name, arr in out.items()}
 
     def _pad_to_bucket(
         self,
@@ -310,10 +322,14 @@ class TPUModelRuntime(BaseRuntime):
     ) -> np.ndarray:
         """KV-cached autoregressive decoding (models/generation.py).
 
-        Prompt seq and max_new_tokens are padded to power-of-two buckets so
-        one compiled generate program serves the whole bucket; output is
-        truncated to the requested token count. (B, max_new_tokens) int32.
+        Prompt seq, max_new_tokens AND the batch axis are padded to
+        power-of-two buckets so one compiled generate program serves the
+        whole bucket; output is truncated back to the requested rows/tokens.
+        temperature/top_k are traced into the program (not static), so novel
+        sampling configs never trigger a recompile. (B, max_new_tokens) int32.
         """
+        import math as _math
+
         import jax
 
         loaded = self._resident.get(model_id)
@@ -338,6 +354,10 @@ class TPUModelRuntime(BaseRuntime):
                 raise RuntimeError_(f"bad prompt_lengths {lengths!r} for shape {ids.shape}")
         if max_new_tokens < 1:
             raise RuntimeError_("max_new_tokens must be >= 1")
+        if not _math.isfinite(temperature) or temperature < 0.0:
+            raise RuntimeError_(f"temperature must be a finite value >= 0, got {temperature}")
+        if top_k < 0:
+            raise RuntimeError_(f"top_k must be >= 0, got {top_k}")
         max_seq = loaded.model_def.config["max_seq"]
         s_bucket = next_bucket(s)
         new_bucket = next_bucket(max_new_tokens)
@@ -352,6 +372,13 @@ class TPUModelRuntime(BaseRuntime):
                 )
         if s_bucket != s:
             ids = np.pad(ids, ((0, 0), (0, s_bucket - s)))
+        # batch axis buckets too: a client-chosen batch size must not mint a
+        # fresh compile per novel B (padding rows decode junk that's sliced
+        # off below; prompt_length 1 keeps their mask valid)
+        b_bucket = next_bucket(b)
+        if b_bucket != b:
+            ids = np.pad(ids, ((0, b_bucket - b), (0, 0)))
+            lengths = np.pad(lengths, (0, b_bucket - b), constant_values=1)
         with TRACER.span(
             "generate", model=str(model_id), tokens=new_bucket, batch=b
         ):
@@ -366,7 +393,7 @@ class TPUModelRuntime(BaseRuntime):
                 rng=jax.random.PRNGKey(seed),
             )
             toks = np.asarray(jax.device_get(toks))
-        return toks[:, :max_new_tokens]
+        return toks[:b, :max_new_tokens]
 
     # -- unload / introspection --------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
@@ -401,7 +428,11 @@ class TPUModelRuntime(BaseRuntime):
         if loaded is None:
             raise ModelNotLoadedError(f"model {model_id} is not loaded")
         d = loaded.model_def
-        return d.input_spec, d.output_spec, d.method_name
+        # derived outputs advertised alongside concrete ones so clients can
+        # discover filterable names via GetModelMetadata
+        out_spec = dict(d.output_spec)
+        out_spec.update({name: spec for name, (_fn, spec) in d.derived_outputs.items()})
+        return d.input_spec, out_spec, d.method_name
 
     def check(self) -> None:
         """Health probe: the devices must answer a trivial computation
@@ -424,8 +455,8 @@ class TPUModelRuntime(BaseRuntime):
     def _update_gauges(self) -> None:
         if self.metrics is None:
             return
-        self.metrics.hbm_bytes_in_use.set(self._resident.total_bytes)
-        self.metrics.models_resident.set(len(self._resident))
+        self.metrics.hbm_bytes_in_use.labels(str(self.group)).set(self._resident.total_bytes)
+        self.metrics.models_resident.labels(str(self.group)).set(len(self._resident))
 
     def close(self) -> None:
         self._resident.clear()

@@ -27,68 +27,122 @@ log = get_logger("server")
 HEALTH_LOOP_PERIOD_S = 30.0  # reference main.go:41
 
 
+class ServingGroup:
+    """One chip group's full serving stack: group mesh -> runtime -> manager
+    -> backend -> its own REST/gRPC server pair. A group is a ring member
+    (SURVEY.md §7 step 8: the ring assigns models to chip GROUPS, not hosts;
+    the group's distinct ports make (host, group) addressable by peers)."""
+
+    def __init__(self, index: int, manager: CacheManager, backend, rest, grpc) -> None:
+        self.index = index
+        self.manager = manager
+        self.backend = backend
+        self.rest = rest
+        self.grpc = grpc
+        self.rest_port = 0
+        self.grpc_port = 0
+
+
 class CacheNode:
-    """One serving node: provider -> disk cache -> JAX runtime behind the
-    REST/gRPC protocol servers."""
+    """One serving host: provider + disk cache shared across its chip-group
+    runtimes, each group behind its own REST/gRPC protocol servers."""
 
     def __init__(self, cfg: Config, runtime=None) -> None:
         self.cfg = cfg
         self.metrics = Metrics(model_labels=cfg.metrics.model_labels)
         provider = create_provider(cfg.model_provider)
         disk_cache = ModelDiskCache(cfg.cache.base_dir, cfg.cache.disk_capacity_bytes)
-        if runtime is None:
+        self.disk_cache = disk_cache
+
+        if runtime is not None:
+            runtimes = [runtime]
+        else:
             from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
 
-            mesh = None
             if cfg.mesh.chips_per_group > 1:
                 import jax
 
                 from tfservingcache_tpu.parallel.mesh import group_mesh
 
-                # this node serves chip group 0 of its local devices; the ring
-                # assigns models to nodes = chip groups (SURVEY.md §7 step 8)
-                mesh = group_mesh(jax.devices(), cfg.mesh.chips_per_group, 0)
-            runtime = TPUModelRuntime(cfg.serving, self.metrics, mesh=mesh)
-        self.manager = CacheManager(provider, disk_cache, runtime, self.metrics)
-        self.backend = LocalServingBackend(
-            self.manager,
-            batch_window_ms=cfg.serving.batch_window_ms,
-            batch_max_size=cfg.serving.batch_max_size,
-        )
-        self.rest = RestServingServer(
-            self.backend,
-            self.metrics,
-            require_version=False,
-            metrics_path=cfg.metrics.path,
-            metrics_scrape_targets=cfg.metrics.scrape_targets,
-        )
-        self.grpc = GrpcServingServer(
-            self.backend, self.metrics, cfg.proxy.grpc_max_message_bytes
-        )
+                devices = jax.devices()
+                n_groups = max(1, len(devices) // cfg.mesh.chips_per_group)
+                runtimes = [
+                    TPUModelRuntime(
+                        cfg.serving,
+                        self.metrics,
+                        mesh=group_mesh(devices, cfg.mesh.chips_per_group, i),
+                        group=i,
+                    )
+                    for i in range(n_groups)
+                ]
+            else:
+                runtimes = [TPUModelRuntime(cfg.serving, self.metrics)]
+
+        self.groups: list[ServingGroup] = []
+        for i, rt in enumerate(runtimes):
+            manager = CacheManager(
+                provider, disk_cache, rt, self.metrics,
+                load_timeout_s=cfg.serving.load_timeout_s,
+            )
+            backend = LocalServingBackend(
+                manager,
+                batch_window_ms=cfg.serving.batch_window_ms,
+                batch_max_size=cfg.serving.batch_max_size,
+            )
+            # every group records into the SHARED Metrics registry (request/
+            # error/latency counters must cover all groups); only group 0
+            # mounts the /metrics exposition endpoint for the host
+            rest = RestServingServer(
+                backend,
+                self.metrics,
+                require_version=False,
+                metrics_path=cfg.metrics.path if i == 0 else None,
+                metrics_scrape_targets=cfg.metrics.scrape_targets,
+            )
+            grpc = GrpcServingServer(
+                backend, self.metrics, cfg.proxy.grpc_max_message_bytes
+            )
+            self.groups.append(ServingGroup(i, manager, backend, rest, grpc))
         self._health_task: asyncio.Task | None = None
 
+    # group-0 aliases: the single-group shape most callers/tests use
+    @property
+    def manager(self) -> CacheManager:
+        return self.groups[0].manager
+
+    @property
+    def backend(self):
+        return self.groups[0].backend
+
     async def start(self) -> tuple[int, int]:
-        rest_port = await self.rest.start(self.cfg.cache_node.rest_port)
-        grpc_port = await self.grpc.start(self.cfg.cache_node.grpc_port)
+        """Start every group's servers. Group i binds base_port + i (or an
+        ephemeral port when the base is 0). Returns group 0's ports."""
+        for g in self.groups:
+            rest_base = self.cfg.cache_node.rest_port
+            grpc_base = self.cfg.cache_node.grpc_port
+            g.rest_port = await g.rest.start(rest_base + g.index if rest_base else 0)
+            g.grpc_port = await g.grpc.start(grpc_base + g.index if grpc_base else 0)
         self._health_task = asyncio.create_task(self._health_loop())
-        return rest_port, grpc_port
+        return self.groups[0].rest_port, self.groups[0].grpc_port
 
     def is_healthy(self) -> bool:
-        return self.manager.is_healthy()
+        return all(g.manager.is_healthy() for g in self.groups)
 
     async def _health_loop(self) -> None:
         while True:
             healthy = await asyncio.get_running_loop().run_in_executor(None, self.is_healthy)
-            self.grpc.set_health(healthy)
+            for g in self.groups:
+                g.grpc.set_health(healthy)
             await asyncio.sleep(HEALTH_LOOP_PERIOD_S)
 
     async def close(self) -> None:
         if self._health_task is not None:
             self._health_task.cancel()
-        self.backend.close()
-        await self.rest.close()
-        await self.grpc.close()
-        self.manager.close()
+        for g in self.groups:
+            g.backend.close()
+            await g.rest.close()
+            await g.grpc.close()
+            g.manager.close()
 
 
 async def serve(cfg: Config) -> None:

@@ -74,11 +74,15 @@ class Metrics:
             registry=r,
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120),
         )
+        # labeled by chip group: one host may run several group runtimes,
+        # each with its own HBM budget (ring members = chip groups)
         self.hbm_bytes_in_use = Gauge(
-            "tpusc_hbm_bytes_in_use", "Bytes of HBM pinned by resident models", registry=r
+            "tpusc_hbm_bytes_in_use", "Bytes of HBM pinned by resident models",
+            ["group"], registry=r,
         )
         self.models_resident = Gauge(
-            "tpusc_models_resident", "Models currently AVAILABLE in the runtime", registry=r
+            "tpusc_models_resident", "Models currently AVAILABLE in the runtime",
+            ["group"], registry=r,
         )
         self.disk_bytes_in_use = Gauge(
             "tpusc_disk_cache_bytes_in_use", "Bytes used by the disk artifact cache", registry=r
