@@ -129,7 +129,7 @@ def _peak_flops(device_kind: str) -> float | None:
 
 
 def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
-                config: dict | None = None):
+                config: dict | None = None, resident_cap: int | None = None):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
@@ -148,7 +148,7 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
     runtime = TPUModelRuntime(
         ServingConfig(
             hbm_capacity_bytes=hbm_gb << 30,
-            max_concurrent_models=max(tenants, 4),
+            max_concurrent_models=resident_cap or max(tenants, 4),
         )
     )
     manager = CacheManager(provider, cache, runtime)
@@ -346,6 +346,45 @@ def bench_flash_kernel() -> dict:
     }
 
 
+def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dict:
+    """Scaled-down 1000-tenant scenario on the real chip: HBM cap forces
+    churn, zipfian stream measures hit-rate + churned-request latency
+    (tests/test_soak.py runs the full 1000 on the CPU harness)."""
+    import numpy as np
+
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    manager, runtime = _make_stack("half_plus_two", tenants, tmp, resident_cap=16)
+    x = {"x": np.ones((4,), np.float32)}
+    for i in range(tenants):  # cold sweep
+        mid = ModelId(f"tenant{i}", 1)
+        manager.ensure_servable(mid)
+        runtime.predict(mid, x)
+    rng = np.random.default_rng(0)
+    ranks = np.minimum(rng.zipf(1.3, size=requests), tenants) - 1
+    lat = []
+    hits = 0
+    for r in ranks:
+        mid = ModelId(f"tenant{int(r)}", 1)
+        t0 = time.perf_counter()
+        warm = runtime.is_loaded(mid)
+        manager.ensure_servable(mid)
+        runtime.predict(mid, x)
+        lat.append(time.perf_counter() - t0)
+        hits += int(warm)
+    manager.close()
+    lat.sort()
+    return {
+        "tenants": tenants,
+        "requests": requests,
+        "resident_cap": 16,
+        "hbm_hit_rate": round(hits / requests, 3),
+        "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        "p95_ms": round(lat[int(0.95 * (len(lat) - 1))] * 1e3, 3),
+    }
+
+
 def run(args) -> dict:
     detail: dict = {}
     platform, diag = probe_backend(args.init_timeout_s)
@@ -411,6 +450,11 @@ def run(args) -> dict:
         detail["flash_kernel"] = bench_flash_kernel()
     except Exception as e:  # noqa: BLE001 - kernel trouble must not sink the bench
         detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
+
+    try:
+        detail["tenant_soak"] = bench_tenant_soak(tmp)
+    except Exception as e:  # noqa: BLE001
+        detail["tenant_soak"] = {"error": f"{type(e).__name__}: {e}"}
 
     for fam in ("mnist_cnn", "transformer_lm"):
         detail[fam] = {

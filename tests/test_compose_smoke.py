@@ -1,0 +1,34 @@
+"""CI wrapper for the scripted two-node smoke flow — the reference only
+documents this as manual curl steps (deploy/docker-compose/readme.md:8-50,
+with a TODO admitting no integration test exists); here it runs on every
+test pass in the no-docker local mode (two real `cli serve` processes, file
+discovery, routed curl flow)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "deploy", "docker-compose", "smoke.sh"
+)
+
+
+@pytest.mark.skipif(shutil.which("bash") is None, reason="needs bash")
+def test_local_two_process_smoke():
+    env = dict(os.environ)
+    # the child processes must pick the CPU backend regardless of the
+    # harness's JAX pinning; the script sets TPUSC_SERVING_PLATFORM itself
+    proc = subprocess.run(
+        ["bash", SCRIPT, "--local"],
+        capture_output=True,
+        text=True,
+        timeout=280,
+        env=env,
+    )
+    sys.stdout.write(proc.stdout[-4000:])
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{proc.stdout[-4000:]}\n{proc.stderr[-4000:]}"
+    assert "SMOKE PASSED" in proc.stdout

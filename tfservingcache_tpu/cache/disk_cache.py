@@ -160,6 +160,12 @@ class ModelDiskCache:
             except OSError:
                 pass
         log.info("evicted %s from disk cache (%d bytes)", model_id, entry.size_bytes)
+        # prune this model's key lock (bounded memory under tenant churn); a
+        # racer holding the popped lock at worst repeats idempotent work
+        with self._key_locks_guard:
+            held = self._key_locks.get(model_id)
+            if held is not None and not held.locked():
+                del self._key_locks[model_id]
         for cb in list(self._evict_callbacks):
             try:
                 cb(model_id)

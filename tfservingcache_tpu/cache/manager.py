@@ -48,6 +48,16 @@ class CacheManager:
         # cold-path deadline over fetch+compile (reference: hardcoded 10 s
         # fetch timeout, cmd/taskhandler/main.go:122). None/0 disables.
         self.load_timeout_s = load_timeout_s or None
+        # resolve_version memo: an unversioned request for an unknown name
+        # otherwise costs a full provider listing PER REQUEST — a hot-path
+        # stall at 1000 tenants. Positive entries cache the provider's
+        # latest; negative entries cache "name doesn't exist" briefly so a
+        # storm of bad names can't hammer the store.
+        self._version_cache: dict[str, tuple[int, float]] = {}
+        self._negative_cache: dict[str, float] = {}
+        self._version_cache_lock = threading.Lock()
+        self.version_cache_ttl_s = 10.0
+        self.negative_cache_ttl_s = 2.0
         # a model evicted from the disk tier must not keep serving from HBM:
         # its artifact is gone, a restart would break the invariant that
         # resident => re-loadable (subscribe, don't overwrite: several
@@ -187,7 +197,29 @@ class CacheManager:
             return max(loaded)
         if known:
             return max(known)
-        return self.provider.latest_version(name)
+        from tfservingcache_tpu.cache.providers.base import ModelNotFoundError
+
+        now = time.monotonic()
+        with self._version_cache_lock:
+            hit = self._version_cache.get(name)
+            if hit is not None and hit[1] > now:
+                return hit[0]
+            neg = self._negative_cache.get(name)
+            if neg is not None and neg > now:
+                raise ModelNotFoundError(f"model {name!r} not found (cached)")
+        try:
+            latest = self.provider.latest_version(name)
+        except ModelNotFoundError:
+            with self._version_cache_lock:
+                if len(self._negative_cache) > 4096:
+                    self._negative_cache.clear()
+                self._negative_cache[name] = now + self.negative_cache_ttl_s
+            raise
+        with self._version_cache_lock:
+            if len(self._version_cache) > 4096:
+                self._version_cache.clear()
+            self._version_cache[name] = (latest, now + self.version_cache_ttl_s)
+        return latest
 
     def available_versions(self, name: str) -> list[int]:
         """All versions the node could serve, ascending: the provider's

@@ -29,6 +29,13 @@ def main(argv: list[str] | None = None) -> int:
 
     cfg = load_config(args.config)
     setup_logging(cfg.logging.level, cfg.logging.fmt)
+    if cfg.serving.platform:
+        # before any backend init (serve AND export both touch jax): a
+        # JAX_PLATFORMS env var alone does not beat an installed PJRT
+        # plugin's registration — only the config update reliably selects
+        import jax
+
+        jax.config.update("jax_platforms", cfg.serving.platform)
 
     if args.cmd == "serve":
         from tfservingcache_tpu.server import run_server

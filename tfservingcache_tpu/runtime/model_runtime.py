@@ -412,6 +412,13 @@ class TPUModelRuntime(BaseRuntime):
                 else:
                     self._jitted_by_key[key] = (jitted, refs - 1)
         self._set_state(model_id, ModelState.END)
+        # prune the per-model load lock so a 1000-tenant churn doesn't grow
+        # the dict forever; a racer holding the popped lock only risks one
+        # redundant (idempotent) load, never corruption
+        with self._load_locks_guard:
+            lock = self._load_locks.get(model_id)
+            if lock is not None and not lock.locked():
+                del self._load_locks[model_id]
         if self.metrics is not None:
             self.metrics.evictions.labels("hbm").inc()
             self._update_gauges()

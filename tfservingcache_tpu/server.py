@@ -173,4 +173,11 @@ async def serve(cfg: Config) -> None:
 
 
 def run_server(cfg: Config) -> None:
+    if cfg.serving.platform:
+        # must happen before backend init: a JAX_PLATFORMS env var alone does
+        # not beat an installed PJRT plugin's registration (see conftest.py) —
+        # only the config update reliably selects the platform
+        import jax
+
+        jax.config.update("jax_platforms", cfg.serving.platform)
     asyncio.run(serve(cfg))
