@@ -10,6 +10,10 @@
 #   ./smoke.sh --docker   force the compose pair
 set -euo pipefail
 cd "$(dirname "$0")"
+# local mode runs `python -m tfservingcache_tpu.cli` from this directory:
+# make the checkout importable without requiring a pip install
+REPO_ROOT="$(cd ../.. && pwd)"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
 
 MODE="${1:---auto}"
 have_docker() { docker compose version >/dev/null 2>&1 && docker info >/dev/null 2>&1; }

@@ -10,14 +10,18 @@ import os
 # wins over a JAX_PLATFORMS=cpu env var set before import — only
 # jax.config.update("jax_platforms", "cpu") reliably forces CPU here, so the
 # eager jax import below is load-bearing, not belt-and-suspenders.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+if os.environ.get("TPUSC_TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
+else:
+    # tools/tpu_kernel_check.py: run the TPU-gated tests on the real chip
+    import jax  # noqa: F401
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

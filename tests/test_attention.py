@@ -77,29 +77,43 @@ def test_gqa_rejects_non_divisible_heads():
     jax.default_backend() not in ("tpu", "axon"),
     reason="needs real TPU (conftest forces CPU; run via tools/tpu_kernel_check.py)",
 )
-def test_flash_kernel_compiles_and_wins_on_tpu():
+@pytest.mark.parametrize(
+    "b,hq,hkv,s,d",
+    [
+        (4, 8, 4, 1024, 64),      # bench preset shape (GQA)
+        (4, 32, 32, 2048, 128),   # llama-7b-class MHA shape
+    ],
+)
+def test_flash_kernel_compiles_and_wins_on_tpu(b, hq, hkv, s, d):
     """Hardware proof for the Pallas kernel: compiles interpret=False,
-    matches the jnp reference, and beats it at LM-serving shapes."""
-    import time
+    matches the jnp reference, and beats it at LM-serving shapes. Timing is
+    chained on-device (utils/benchtime.py) — naive loops over identical
+    inputs are meaningless through the remote-TPU transport."""
+    from tfservingcache_tpu.utils.benchtime import chained_device_time
 
-    q, k, v = rand_qkv(4, 8, 1024, 64, dtype=jnp.bfloat16, seed=5)
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True)  # interpret=False: real Mosaic compile
     ref = attention_reference(q, k, v, causal=True)
-    np.testing.assert_allclose(
-        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+    # error reduced ON DEVICE: fetching two full (B,H,S,D) tensors over a
+    # remote-TPU link takes minutes at llama shapes
+    err = float(
+        jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
     )
+    assert err < 3e-2, f"flash kernel diverges from reference: max abs err {err}"
 
-    def timeit(fn, iters=20):
-        fn().block_until_ready()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            r = fn()
-        r.block_until_ready()
-        return (time.perf_counter() - t0) / iters
-
-    t_flash = timeit(lambda: flash_attention(q, k, v, causal=True))
-    t_ref = timeit(lambda: jax.jit(attention_reference, static_argnames="causal")(q, k, v, causal=True))
-    assert t_flash < t_ref, f"flash {t_flash*1e3:.2f}ms not faster than jnp {t_ref*1e3:.2f}ms"
+    t_flash = chained_device_time(
+        lambda q, k, v: flash_attention(q, k, v, causal=True), (q, k, v)
+    )
+    t_ref = chained_device_time(
+        lambda q, k, v: attention_reference(q, k, v, causal=True), (q, k, v)
+    )
+    assert t_flash < t_ref, (
+        f"flash {t_flash*1e3:.2f}ms not faster than jnp {t_ref*1e3:.2f}ms "
+        f"at {(b, hq, hkv, s, d)}"
+    )
 
 
 def test_flash_uneven_blocks():

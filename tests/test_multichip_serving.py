@@ -58,15 +58,20 @@ async def test_sharded_predict_through_backend_matches_unsharded(tmp_path):
 
     try:
         ids = [[3, 1, 4, 1, 5]]
-        body = json.dumps({"inputs": {"input_ids": ids}}).encode()
+        # full logits are opt-in (LM default output is last_token_logits)
+        body = json.dumps(
+            {"inputs": {"input_ids": ids}, "output_filter": ["logits"]}
+        ).encode()
         resp = await backend.handle_rest("POST", "lm", 1, "predict", body)
         assert resp.status == 200, resp.body
         got = np.asarray(json.loads(resp.body)["outputs"], np.float32)
 
         mgr_1.ensure_servable(ModelId("lm", 1))
-        want = rt_1.predict(ModelId("lm", 1), {"input_ids": np.asarray(ids, np.int32)})[
-            "logits"
-        ]
+        want = rt_1.predict(
+            ModelId("lm", 1),
+            {"input_ids": np.asarray(ids, np.int32)},
+            output_filter=["logits"],
+        )["logits"]
         assert got.shape == want.shape == (1, 5, SMALL["vocab_size"])
         # bf16 shard reductions reorder; demand tight-but-not-bitwise parity
         np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
@@ -136,7 +141,11 @@ async def test_two_group_cache_node_rings_models_to_groups(tmp_path):
             for i in range(n_tenants):
                 url = f"http://127.0.0.1:{rr_port}/v1/models/t{i}/versions/1:predict"
                 async with s.post(
-                    url, json={"inputs": {"input_ids": [[1, 2, 3]]}}
+                    url,
+                    json={
+                        "inputs": {"input_ids": [[1, 2, 3]]},
+                        "output_filter": ["logits"],  # full logits are opt-in
+                    },
                 ) as resp:
                     assert resp.status == 200, await resp.text()
                     out = np.asarray((await resp.json())["outputs"], np.float32)
@@ -158,13 +167,17 @@ async def test_two_group_cache_node_rings_models_to_groups(tmp_path):
         try:
             mid = ModelId("t0", 1)
             mgr_1.ensure_servable(mid)
-            want = rt_1.predict(mid, {"input_ids": np.array([[1, 2, 3]], np.int32)})
+            want = rt_1.predict(
+                mid, {"input_ids": np.array([[1, 2, 3]], np.int32)},
+                output_filter=["logits"],
+            )
             owner = next(
                 g for g in node.groups
                 if mid in g.manager.runtime.resident_models()
             )
             got = owner.manager.runtime.predict(
-                mid, {"input_ids": np.array([[1, 2, 3]], np.int32)}
+                mid, {"input_ids": np.array([[1, 2, 3]], np.int32)},
+                output_filter=["logits"],
             )
             np.testing.assert_allclose(
                 got["logits"], want["logits"], atol=5e-2, rtol=5e-2

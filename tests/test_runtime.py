@@ -74,7 +74,7 @@ def test_derived_last_token_logits(runtime, tmp_path):
     model = make_model(tmp_path, family="transformer_lm", name="lm_last", config=tiny)
     runtime.ensure_loaded(model)
     ids = np.random.default_rng(0).integers(1, 97, (3, 5)).astype(np.int32)  # pads: b->4, s->8
-    full = runtime.predict(model.identifier, {"input_ids": ids})
+    full = runtime.predict(model.identifier, {"input_ids": ids}, output_filter=["logits"])
     last = runtime.predict(
         model.identifier, {"input_ids": ids}, output_filter=["last_token_logits"]
     )

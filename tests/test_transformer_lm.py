@@ -59,13 +59,23 @@ def test_runtime_serves_transformer_with_seq_bucketing(tmp_path):
         rt.ensure_loaded(model)
         # seq 5 pads to bucket 8; output must be sliced back to (2, 5, V)
         ids = np.tile(np.array([[9, 8, 7, 6, 5]], np.int32), (2, 1))
-        out = rt.predict(model.identifier, {"input_ids": ids})
+        # LM serving default ships only the next-token logits (B, V)
+        dflt = rt.predict(model.identifier, {"input_ids": ids})
+        assert set(dflt) == {"last_token_logits"}
+        assert dflt["last_token_logits"].shape == (2, 128)
+        out = rt.predict(model.identifier, {"input_ids": ids}, output_filter=["logits"])
         assert out["logits"].shape == (2, 5, 128)
+        np.testing.assert_allclose(
+            dflt["last_token_logits"], out["logits"][:, -1, :], atol=1e-5, rtol=1e-5
+        )
         # bucketed shapes: a second call with seq 6 reuses the same (2^k)
-        out2 = rt.predict(model.identifier, {"input_ids": np.ones((1, 6), np.int32)})
+        out2 = rt.predict(
+            model.identifier, {"input_ids": np.ones((1, 6), np.int32)},
+            output_filter=["logits"],
+        )
         assert out2["logits"].shape == (1, 6, 128)
         # padding must not change valid-position logits (causal)
-        solo = rt.predict(model.identifier, {"input_ids": ids[:1]})
+        solo = rt.predict(model.identifier, {"input_ids": ids[:1]}, output_filter=["logits"])
         np.testing.assert_allclose(
             solo["logits"][0], out["logits"][0], atol=2e-4, rtol=2e-4
         )

@@ -173,6 +173,13 @@ def build(config: dict) -> ModelDef:
         r".*ln.*": (None,),
     }
 
+    def last_token_logits(outputs, dyn_sizes):
+        # device-side slice at the last REAL position (seq is bucket-padded)
+        logits = outputs["logits"]
+        s = dyn_sizes.get("seq", logits.shape[1])
+        b = dyn_sizes.get("batch", logits.shape[0])
+        return logits[:b, s - 1, :]
+
     return ModelDef(
         family="moe_lm",
         config=cfg,
@@ -182,4 +189,14 @@ def build(config: dict) -> ModelDef:
         output_spec={"logits": TensorSpec("float32", ("batch", "seq", cfg["vocab_size"]))},
         partition_rules=partition_rules,
         loss=loss,
+        derived_outputs={
+            "last_token_logits": (
+                last_token_logits,
+                TensorSpec("float32", ("batch", cfg["vocab_size"])),
+            )
+        },
+        # same LM serving default as transformer_lm: next-token logits out of
+        # the box, full (B, S, V) logits via output_filter=["logits"]
+        default_outputs=["last_token_logits"],
+        store_param_dtype=cfg["dtype"],
     )

@@ -87,6 +87,16 @@ class ModelDef:
     derived_outputs: dict[str, tuple[Callable[..., Any], TensorSpec]] = field(
         default_factory=dict
     )
+    # outputs served when a request names none (output_filter unset). LM
+    # families default to ["last_token_logits"]: shipping the full padded
+    # (B, S, V) logits tensor per request made warm REST 0.5 qps — clients
+    # wanting everything ask for it explicitly (output_filter=["logits"]).
+    default_outputs: list[str] | None = None
+    # float params are cast to this dtype when the artifact is written (the
+    # family's apply casts weights to its compute dtype anyway): a bf16
+    # artifact halves both disk reads and the host->device transfer that
+    # dominates the cold-miss path.
+    store_param_dtype: str | None = None
 
 
 _REGISTRY: dict[str, Callable[[dict[str, Any]], ModelDef]] = {}
@@ -162,13 +172,23 @@ class ArtifactError(Exception):
 
 
 def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
+    import jax
     from flax import serialization
 
     os.makedirs(dest_dir, exist_ok=True)
+    if model.store_param_dtype:
+        nd = np.dtype(model.store_param_dtype)
+
+        def cast(x):
+            a = np.asarray(x)
+            return a.astype(nd) if a.dtype.kind == "f" and a.dtype != nd else a
+
+        params = jax.tree_util.tree_map(cast, params)
     meta = {
         "format": ARTIFACT_FORMAT,
         "family": model.family,
         "config": model.config,
+        "param_dtype": model.store_param_dtype,
         "signature": {
             "inputs": {k: [v.dtype, list(v.shape)] for k, v in model.input_spec.items()},
             "outputs": {k: [v.dtype, list(v.shape)] for k, v in model.output_spec.items()},
@@ -220,10 +240,22 @@ def export_artifact(
     seed: int = 0,
 ) -> str:
     """Initialize a family with fresh params and write
-    ``<base_dir>/<name>/<version>/`` (used by the CLI, tests and bench)."""
+    ``<base_dir>/<name>/<version>/`` (used by the CLI, tests and bench).
+
+    Init runs on the host CPU backend: an export is offline tooling, and
+    running jax.random on an accelerator would round-trip every fresh
+    parameter tensor over the host<->device link just to write it to disk."""
     import jax
 
     model = build(family, config)
-    params = model.init(jax.random.PRNGKey(seed))
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is not None:
+        with jax.default_device(cpu):
+            params = jax.device_get(model.init(jax.random.PRNGKey(seed)))
+    else:
+        params = model.init(jax.random.PRNGKey(seed))
     dest = os.path.join(base_dir, name or family, str(version))
     return save_artifact(dest, model, params)

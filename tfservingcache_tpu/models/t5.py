@@ -238,4 +238,6 @@ def build(config: dict) -> ModelDef:
         output_spec={"logits": TensorSpec("float32", ("batch", "tgt", cfg["vocab_size"]))},
         partition_rules=partition_rules,
         loss=loss,
+        # apply casts to cfg dtype; bf16 artifacts halve the cold transfer
+        store_param_dtype=cfg["dtype"],
     )

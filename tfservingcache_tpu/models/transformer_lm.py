@@ -9,7 +9,8 @@ TPU-first design:
     rules (megatron-style: attention/MLP sharded over the "model" mesh axis,
     collectives inserted by XLA from the shardings — no hand-written NCCL,
     SURVEY.md §2 distributed-backend inventory);
-  - weights stored f32 in the artifact, cast to bf16 at apply time.
+  - weights stored in the serving dtype (bf16) in the artifact — the cold
+    path is host->HBM bandwidth-bound, so artifact bytes are the latency.
 
 Config presets cover smoke tests through llama-7b-class shapes.
 """
@@ -202,4 +203,11 @@ def build(config: dict) -> ModelDef:
                 TensorSpec("float32", ("batch", cfg["vocab_size"])),
             )
         },
+        # out-of-box predict ships the (B, V) next-token logits; the full
+        # (B, S, V) tensor is opt-in via output_filter=["logits"] (at seq 128
+        # vocab 4096 that's 8 MB of f32 per request — the round-2 0.5 qps)
+        default_outputs=["last_token_logits"],
+        # apply casts weights to cfg dtype anyway; storing them f32 doubled
+        # the cold-path transfer (round-2 cold p50 3.14 s was ~80% device_put)
+        store_param_dtype=cfg["dtype"],
     )

@@ -179,9 +179,13 @@ class LocalServingBackend(ServingBackend):
 
     def _classify_sync(self, model_id: ModelId, inp: sv.Input) -> sv.ClassificationResult:
         self._ensure_sync(model_id)
-        in_spec, _, _ = self.manager.runtime.signature(model_id)
+        in_spec, out_spec, _ = self.manager.runtime.signature(model_id)
         arrays = self._examples_to_inputs(inp, in_spec)
-        outputs = self._predictor.predict(model_id, arrays)
+        # explicit filter: Classify needs the concrete scores/logits/labels
+        # outputs, which a family's serving default (LMs ship only
+        # last_token_logits) would otherwise drop
+        wanted = [n for n in ("scores", "logits", "labels") if n in out_spec]
+        outputs = self._predictor.predict(model_id, arrays, wanted or None)
         result = sv.ClassificationResult()
         # scores: prefer explicit "scores", else softmax over "logits"
         scores = outputs.get("scores")
@@ -221,8 +225,10 @@ class LocalServingBackend(ServingBackend):
         self._ensure_sync(model_id)
         in_spec, out_spec, _ = self.manager.runtime.signature(model_id)
         arrays = self._examples_to_inputs(inp, in_spec)
-        outputs = self._predictor.predict(model_id, arrays)
-        name = "outputs" if "outputs" in outputs else next(iter(out_spec))
+        # pick the regression output from the SIGNATURE and request it
+        # explicitly — an LM's serving default would omit "logits"
+        name = "outputs" if "outputs" in out_spec else next(iter(out_spec))
+        outputs = self._predictor.predict(model_id, arrays, [name])
         vals = np.asarray(outputs[name], dtype=np.float64).reshape(-1)
         result = sv.RegressionResult()
         for v in vals:

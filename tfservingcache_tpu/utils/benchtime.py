@@ -1,0 +1,54 @@
+"""Trustworthy on-device timing for jittable array functions.
+
+Naive loops (call N times, ``block_until_ready``) lie on remote-attached
+accelerators: async dispatch, transport-level result caching of identical
+(executable, inputs) pairs, and transfer-queue backpressure all corrupt the
+measurement — the round-2 flash-kernel "0.86x regression" and its later
+"50x speedup" were BOTH artifacts of such timing. The fix: chain the N
+executions *inside one compiled program* with a data dependency between
+iterations, so the device must genuinely run every iteration, and subtract
+a 1-iteration run to cancel dispatch/transfer overhead.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, Sequence
+
+
+def chained_device_time(
+    fn: Callable[..., Any],
+    args: Sequence[Any],
+    iters: int = 16,
+) -> float:
+    """Seconds per call of ``fn(*args)`` measured on device.
+
+    ``fn`` must be traceable and return an array (or pytree; the first leaf
+    feeds the inter-iteration dependency). ``args[0]`` must be a float array:
+    iteration i+1 perturbs it by ``1e-6 * out[0]`` so no two iterations are
+    identical and the chain cannot be hoisted, cached, or reordered.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def loop(args, n):
+        def body(carry, _):
+            a, acc = carry
+            out = fn(*a)
+            first = jnp.ravel(jax.tree_util.tree_leaves(out)[0])[0]
+            a = (a[0] + first.astype(a[0].dtype) * 1e-6,) + tuple(a[1:])
+            return (a, acc + first.astype(jnp.float32)), None
+        (a, acc), _ = jax.lax.scan(body, (tuple(args), jnp.float32(0)), None, length=n)
+        return acc
+
+    args = tuple(args)
+    float(loop(args, 1))        # compile the 1-iter program
+    float(loop(args, iters))    # compile the n-iter program
+    t0 = time.perf_counter()
+    float(loop(args, 1))
+    t1 = time.perf_counter()
+    float(loop(args, iters))
+    t2 = time.perf_counter()
+    return max((t2 - t1) - (t1 - t0), 1e-9) / (iters - 1)

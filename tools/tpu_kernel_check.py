@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Run the TPU-gated kernel tests on real hardware.
+
+The CPU test harness (tests/conftest.py) pins JAX to a virtual CPU mesh, so
+the hardware proofs in tests/test_attention.py are skipped there. This tool
+re-runs them with the real backend enabled:
+
+    python tools/tpu_kernel_check.py            # kernel tests only
+    python tools/tpu_kernel_check.py -k gqa     # extra pytest args pass through
+
+Exit code is pytest's — 0 means the Pallas kernel compiled via Mosaic,
+matched the jnp reference, and beat it at every gated shape.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["TPUSC_TEST_ON_TPU"] = "1"  # tests/conftest.py skips the CPU pinning
+    if env.get("JAX_PLATFORMS") == "cpu":
+        del env["JAX_PLATFORMS"]
+    extra = sys.argv[1:]
+    if not extra:
+        # default: just the hardware-gated proofs. The interpret-mode tests'
+        # 2e-5 tolerances are calibrated for CPU math and would spuriously
+        # fail against the MXU's bf16-pass f32 matmuls.
+        extra = ["-k", "on_tpu"]
+    cmd = [
+        sys.executable, "-m", "pytest",
+        os.path.join(REPO, "tests", "test_attention.py"),
+        "-v", "-rs", "--no-header",
+        *extra,
+    ]
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, env=env, cwd=REPO)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
