@@ -1,0 +1,127 @@
+"""Child process for the cross-host chip-group test (tests/test_multihost.py).
+
+Each invocation is one 'host': 4 virtual CPU devices, jax.distributed
+rendezvous, one 8-chip TP group spanning both processes. Process 0 leads the
+group (binds its REST server, answers requests); process 1 runs only the
+group-work service and joins the collectives.
+
+argv: process_id coordinator_port worker0_port worker1_port store_dir run_dir
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+coord = sys.argv[2]
+w0, w1 = sys.argv[3], sys.argv[4]
+store, run_dir = sys.argv[5], sys.argv[6]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import asyncio  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+async def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tfservingcache_tpu.config import Config
+
+    cfg = Config()
+    cfg.model_provider.base_dir = store
+    cfg.cache.base_dir = os.path.join(run_dir, f"cache_{pid}")
+    cfg.cache_node.rest_port = 0
+    cfg.cache_node.grpc_port = 0
+    cfg.serving.load_timeout_s = 120.0
+    cfg.mesh.chips_per_group = 8
+    cfg.mesh.coordinator = f"127.0.0.1:{coord}"
+    cfg.mesh.num_processes = 2
+    cfg.mesh.process_id = pid
+    cfg.mesh.worker_addrs = [f"127.0.0.1:{w0}", f"127.0.0.1:{w1}"]
+
+    from tfservingcache_tpu.server import CacheNode
+
+    node = CacheNode(cfg)
+    rest_port, _ = await node.start()
+
+    if pid != 0:
+        # follower: serve group work until the parent kills us
+        assert not node.groups, "follower must not lead any group"
+        assert node.work_handler is not None
+        print("FOLLOWER READY", flush=True)
+        await asyncio.Event().wait()
+        return
+
+    # leader: the group's mesh must really span both processes
+    assert len(node.groups) == 1
+    mesh = node.groups[0].manager.runtime.mesh
+    procs = {d.process_index for d in mesh.devices.flat}
+    assert procs == {0, 1}, procs
+    print("LEADER READY", flush=True)
+
+    import aiohttp
+
+    ids = [[3, 1, 4, 1, 5]]
+    async with aiohttp.ClientSession() as s:
+        base = f"http://127.0.0.1:{rest_port}/v1/models/lm/versions/1"
+        async with s.post(
+            f"{base}:predict",
+            json={"inputs": {"input_ids": ids}, "output_filter": ["logits"]},
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            got = np.asarray((await resp.json())["outputs"], np.float32)
+        # default output path too (last_token_logits)
+        async with s.post(
+            f"{base}:predict", json={"inputs": {"input_ids": ids}}
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            last = np.asarray((await resp.json())["outputs"], np.float32)
+        # greedy generate across the 2-process group
+        async with s.post(
+            f"{base}:generate",
+            json={"input_ids": ids, "max_new_tokens": 4, "seed": 3},
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            toks = np.asarray((await resp.json())["tokens"], np.int32)
+
+    # parity vs an unsharded runtime on this process's local chips
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+    from tfservingcache_tpu.types import ModelId
+
+    rt1 = TPUModelRuntime(ServingConfig())
+    mgr1 = CacheManager(
+        DiskModelProvider(store),
+        ModelDiskCache(os.path.join(run_dir, "cache_ref"), capacity_bytes=1 << 30),
+        rt1,
+    )
+    mid = ModelId("lm", 1)
+    mgr1.ensure_servable(mid)
+    want = rt1.predict(
+        mid, {"input_ids": np.asarray(ids, np.int32)}, output_filter=["logits"]
+    )["logits"]
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+    corr = np.corrcoef(got.ravel(), np.asarray(want).ravel())[0, 1]
+    assert corr > 0.9999, corr
+    np.testing.assert_allclose(last[0], got[0, -1, :], atol=5e-2, rtol=5e-2)
+    want_toks = rt1.generate(
+        mid, np.asarray(ids, np.int32), max_new_tokens=4, seed=3
+    )
+    np.testing.assert_array_equal(toks, want_toks)  # greedy = exact
+    mgr1.close()
+    await node.close()
+    print("MULTIHOST PARITY OK", flush=True)
+    # skip the interpreter-exit jax.distributed shutdown barrier: the
+    # follower idles until the parent kills it, so the barrier would time
+    # out and abort this (already successful) process
+    os._exit(0)
+
+
+asyncio.run(main())

@@ -112,3 +112,25 @@ def test_encode_row_and_columnar():
 def test_encode_bytes_b64():
     out = {"y": np.array([b"ab"], dtype=object)}
     assert encode_predict_json(out, row_format=True) == {"predictions": [{"b64": "YWI="}]}
+
+
+def test_encode_base64_binary_outputs():
+    import base64
+
+    from tfservingcache_tpu.protocol.codec import _array_to_b64_json
+
+    y = np.arange(6, dtype=np.float32).reshape(2, 3)
+    enc = encode_predict_json({"y": y}, row_format=False, encoding="base64")
+    spec = enc["outputs"]  # single output unwrapped to the spec itself
+    assert spec["dtype"] == "float32" and spec["shape"] == [2, 3]
+    back = np.frombuffer(base64.b64decode(spec["b64"]), np.float32).reshape(2, 3)
+    np.testing.assert_array_equal(back, y)
+    # multi-output keeps names; row_format is ignored for binary
+    multi = encode_predict_json(
+        {"y": y, "z": np.array([1, 2], np.int32)}, row_format=True, encoding="base64"
+    )
+    assert set(multi["outputs"]) == {"y", "z"}
+    assert multi["outputs"]["z"]["dtype"] == "int32"
+    # strings can't be binary-encoded
+    with pytest.raises(CodecError):
+        _array_to_b64_json(np.array([b"x"], dtype=object))

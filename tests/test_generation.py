@@ -177,3 +177,171 @@ async def test_rest_generate_verb(tmp_path):
     finally:
         backend.close()
         mgr.close()
+
+
+async def test_rest_predict_base64_output_encoding(tmp_path):
+    """tpusc binary output path: {"output_encoding": "base64"} answers raw
+    little-endian tensor bytes + dtype + shape (VERDICT r2 #4b)."""
+    import base64
+
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.protocol.backend import BackendError
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="lm", version=1, config=TINY)
+    mgr = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        TPUModelRuntime(ServingConfig(platform="cpu")),
+    )
+    backend = LocalServingBackend(mgr)
+    try:
+        body = json.dumps(
+            {
+                "inputs": {"input_ids": [[1, 2, 3]]},
+                "output_filter": ["logits"],
+                "output_encoding": "base64",
+            }
+        ).encode()
+        resp = await backend.handle_rest("POST", "lm", 1, "predict", body)
+        assert resp.status == 200
+        spec = json.loads(resp.body)["outputs"]
+        assert spec["dtype"] == "float32"
+        arr = np.frombuffer(base64.b64decode(spec["b64"]), np.float32).reshape(
+            spec["shape"]
+        )
+        # parity with the JSON path
+        jbody = json.dumps(
+            {"inputs": {"input_ids": [[1, 2, 3]]}, "output_filter": ["logits"]}
+        ).encode()
+        jresp = await backend.handle_rest("POST", "lm", 1, "predict", jbody)
+        want = np.asarray(json.loads(jresp.body)["outputs"], np.float32)
+        np.testing.assert_allclose(arr, want, atol=1e-6)
+        with pytest.raises(BackendError):
+            await backend.handle_rest(
+                "POST", "lm", 1, "predict",
+                json.dumps(
+                    {"inputs": {"input_ids": [[1]]}, "output_encoding": "hex"}
+                ).encode(),
+            )
+    finally:
+        backend.close()
+        mgr.close()
+
+
+def _lm_stack(tmp_path, **serving_kw):
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="lm", version=1, config=TINY)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu", **serving_kw))
+    mgr = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        rt,
+    )
+    return mgr, rt
+
+
+def test_generate_coalescer_merges_concurrent(tmp_path):
+    """Concurrent unseeded same-bucket :generate requests coalesce into ONE
+    device program; ragged prompts keep per-row lengths; greedy output
+    matches each request's solo run exactly."""
+    import threading
+
+    from tfservingcache_tpu.runtime.batcher import GenerateCoalescer
+    from tfservingcache_tpu.types import ModelId
+
+    mgr, rt = _lm_stack(tmp_path)
+    try:
+        mid = ModelId("lm", 1)
+        mgr.ensure_servable(mid)
+        gc = GenerateCoalescer(rt)
+        prompts = [
+            (np.array([[1, 2, 3, 0]], np.int32), [3]),   # ragged: true len 3
+            (np.array([[4, 5, 6, 7]], np.int32), None),
+            (np.array([[9, 9, 2, 1]], np.int32), None),
+        ]
+        solo = [
+            rt.generate(mid, ids, prompt_lengths=pl, max_new_tokens=4)
+            for ids, pl in prompts
+        ]
+        key = (mid, 4, 4, 0.0, 0)
+        gate = gc._gate(key)
+        results: list = [None] * 3
+        errors: list = []
+
+        def call(i):
+            ids, pl = prompts[i]
+            try:
+                results[i] = gc.generate(mid, ids, prompt_lengths=pl, max_new_tokens=4)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        with gate:  # simulate a busy device: all three join one batch
+            ts = [threading.Thread(target=call, args=(i,)) for i in range(3)]
+            for t in ts:
+                t.start()
+            import time
+
+            time.sleep(0.5)
+        for t in ts:
+            t.join()
+        assert not errors, errors
+        assert gc.batches == 1 and gc.batched_requests == 3
+        for got, want in zip(results, solo):
+            np.testing.assert_array_equal(got, want)  # greedy = deterministic
+    finally:
+        mgr.close()
+
+
+def test_generate_coalescer_seeded_runs_solo(tmp_path):
+    """An explicit seed promises a reproducible solo sample stream — it must
+    bypass coalescing even under concurrent load."""
+    from tfservingcache_tpu.runtime.batcher import GenerateCoalescer
+    from tfservingcache_tpu.types import ModelId
+
+    mgr, rt = _lm_stack(tmp_path)
+    try:
+        mid = ModelId("lm", 1)
+        mgr.ensure_servable(mid)
+        gc = GenerateCoalescer(rt)
+        ids = np.array([[1, 2, 3]], np.int32)
+        a = gc.generate(mid, ids, max_new_tokens=4, temperature=0.9, seed=7)
+        b = gc.generate(mid, ids, max_new_tokens=4, temperature=0.9, seed=7)
+        np.testing.assert_array_equal(a, b)
+        assert gc.batches == 0  # never entered the batching path
+    finally:
+        mgr.close()
+
+
+async def test_rest_generate_deadline_504(tmp_path, monkeypatch):
+    """A hung generate answers 504 DEADLINE_EXCEEDED at load_timeout_s
+    instead of wedging the client (VERDICT r2 weak #7)."""
+    import time as _time
+
+    from tfservingcache_tpu.protocol.backend import BackendError
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+
+    mgr, rt = _lm_stack(tmp_path)
+    mgr.load_timeout_s = 0.5
+
+    def slow_generate(*a, **kw):
+        _time.sleep(5.0)
+        raise AssertionError("unreachable in test")
+
+    monkeypatch.setattr(rt, "generate", slow_generate)
+    backend = LocalServingBackend(mgr, batch_window_ms=0.0)
+    try:
+        body = json.dumps({"input_ids": [[1, 2, 3]], "max_new_tokens": 2}).encode()
+        with pytest.raises(BackendError) as ei:
+            await backend.handle_rest("POST", "lm", 1, "generate", body)
+        assert ei.value.http_status == 504
+    finally:
+        backend.close()
+        mgr.close()

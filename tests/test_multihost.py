@@ -1,0 +1,78 @@
+"""Cross-host chip groups: a 2-process, 8-virtual-device TP group serves
+REST predict/generate with parity against an unsharded runtime.
+
+SURVEY.md §7 hard part (e): the reference's ring (cluster.go:116-130) only
+ever maps a key to one process; here the group's chips live in TWO processes
+— the leader answers the RPC and broadcasts each collective op to the
+follower's group-work service so all processes enter the same XLA program
+(parallel/multihost.py). Real process boundaries, not mocks."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "multihost_child.py")
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_two_process_group_serves_with_parity(tmp_path):
+    # export the artifact ONCE; both 'hosts' read the same store (in prod:
+    # shared object storage), each keeps its own disk cache
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    subprocess.run(
+        [
+            sys.executable, "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from tfservingcache_tpu.models.registry import export_artifact;"
+            "export_artifact('transformer_lm', r'%s', name='lm', version=1,"
+            " config={'vocab_size': 128, 'd_model': 64, 'n_layers': 2,"
+            " 'n_heads': 4, 'n_kv_heads': 2, 'd_ff': 128, 'max_seq': 64,"
+            " 'dtype': 'bfloat16'})" % str(tmp_path / "store"),
+        ],
+        check=True, env=env, cwd=REPO, timeout=120,
+    )
+
+    coord, w0, w1 = _free_ports(3)
+    args = [str(coord), str(w0), str(w1), str(tmp_path / "store"), str(tmp_path)]
+    child_env = dict(os.environ)
+    child_env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, str(pid), *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=child_env, cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        out0, _ = procs[0].communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        procs[0].kill()
+        out0 = procs[0].communicate()[0]
+        pytest.fail(f"leader timed out; output:\n{out0[-4000:]}")
+    finally:
+        procs[1].terminate()
+        try:
+            out1, _ = procs[1].communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            procs[1].kill()
+            out1 = procs[1].communicate()[0]
+    assert procs[0].returncode == 0, f"leader:\n{out0[-4000:]}\nfollower:\n{out1[-4000:]}"
+    assert "MULTIHOST PARITY OK" in out0
+    assert "FOLLOWER READY" in out1

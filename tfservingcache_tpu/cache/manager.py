@@ -163,6 +163,17 @@ class CacheManager:
             raise box["error"]
         return box.get("value")
 
+    def prefetch(self, model_id: ModelId) -> Model:
+        """Host-side half of a cold miss only: artifact onto local disk, the
+        runtime untouched. Cross-host groups use this as a joinable phase 1
+        (parallel/multihost.py) so provider/IO failures surface BEFORE any
+        process enters a collective it could strand the others in."""
+        with self.disk_cache.fetch_lock(model_id):
+            model = self.disk_cache.get(model_id)
+            if model is not None:
+                return model
+            return self._fetch(model_id)
+
     def _fetch(self, model_id: ModelId) -> Model:
         """MISS path: size -> evict-to-fit -> provider fetch -> index.
         Reference cachemanager.go:114-127 (minus its double-eviction quirk)."""

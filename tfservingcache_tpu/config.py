@@ -109,11 +109,21 @@ class DiscoveryConfig:
 class MeshConfig:
     """TPU chip-group topology — new territory (SURVEY.md §2 parallelism
     inventory: the reference has none). Models larger than one chip are
-    sharded over a chip group; the ring assigns models to groups."""
+    sharded over a chip group; the ring assigns models to groups.
+
+    Cross-host groups (chips_per_group > chips per host): set ``coordinator``
+    (jax.distributed rendezvous, e.g. host0:8476), ``num_processes``,
+    ``process_id``, and one ``worker_addrs`` "host:port" entry PER PROCESS —
+    the group-work endpoint its leader broadcasts collective ops to
+    (parallel/multihost.py). The group's leader process is its ring member."""
 
     chips_per_group: int = 1           # chip-group size for sharded models
     axis_names: tuple[str, ...] = ("data", "model")
     data_parallel: int = 1
+    coordinator: str = ""              # jax.distributed coordinator address
+    num_processes: int = 1
+    process_id: int = 0
+    worker_addrs: list[str] = field(default_factory=list)  # per-process host:port
 
 
 @dataclass

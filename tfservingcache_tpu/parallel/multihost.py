@@ -1,0 +1,307 @@
+"""Cross-host chip groups: one model sharded over chips owned by SEVERAL
+processes (SURVEY.md §7 hard part (e) — the reference's ring semantics,
+cluster.go:116-130, generalized to groups with no single-process owner).
+
+Design. JAX multi-controller SPMD requires every process in a group to run
+the SAME program in the SAME order — but serving is request-driven and only
+one process receives each RPC. So:
+
+  - the group's LEADER (the process owning the group's first device) is its
+    ring member: it binds the group's REST/gRPC ports and answers requests;
+  - follower processes run a tiny HTTP *work service*; before executing any
+    collective op (load+warmup, predict, generate, unload), the leader
+    broadcasts the op + its full inputs to every follower, which replays it
+    against its own manager/runtime — all processes then enter the same
+    jitted program and XLA's collectives ride ICI/DCN;
+  - the broadcast is FIRE-THEN-COMPUTE: the leader must start its own
+    computation while followers run theirs (joining the HTTP responses first
+    would deadlock the collective), so responses are collected after;
+  - a per-group lock on the leader serializes ops, which is what guarantees
+    every process sees the same op order. Followers execute work items under
+    their own per-group lock.
+
+The data plane between hosts stays HTTP/gRPC over DCN exactly as SURVEY §5
+prescribes for the routing layer; only tensors INSIDE the jitted program
+move over XLA collectives.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Mapping
+
+import numpy as np
+
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("multihost")
+
+WORK_PATH = "/tpusc/groupwork"
+
+
+def encode_work(meta: dict, arrays: Mapping[str, np.ndarray] | None = None) -> bytes:
+    """npz envelope: JSON meta + named tensors (no pickle — work requests
+    cross a trust boundary between processes)."""
+    buf = io.BytesIO()
+    payload = {f"t_{k}": np.asarray(v) for k, v in (arrays or {}).items()}
+    payload["meta"] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def decode_work(body: bytes) -> tuple[dict, dict[str, np.ndarray]]:
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        arrays = {k[2:]: z[k] for k in z.files if k.startswith("t_")}
+    return meta, arrays
+
+
+class GroupWorkHandler:
+    """Follower side: executes broadcast collective ops for the cross-host
+    groups this process participates in (but does not lead)."""
+
+    def __init__(self) -> None:
+        # group index -> (manager, runtime)
+        self._groups: dict[int, tuple[Any, TPUModelRuntime]] = {}
+        self._locks: dict[int, threading.Lock] = {}
+        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="tpusc-gw")
+
+    def register(self, group_index: int, manager, runtime: TPUModelRuntime) -> None:
+        self._groups[group_index] = (manager, runtime)
+        self._locks[group_index] = threading.Lock()
+
+    @property
+    def group_indexes(self) -> list[int]:
+        return sorted(self._groups)
+
+    def _execute(self, meta: dict, arrays: dict[str, np.ndarray]) -> None:
+        gi = int(meta["group"])
+        manager, runtime = self._groups[gi]
+        mid = ModelId(meta["model"], int(meta["version"]))
+        op = meta["op"]
+        with self._locks[gi]:  # same-order guarantee as the leader's lock
+            if op == "prefetch":
+                manager.prefetch(mid)  # host-side IO only, no collectives
+            elif op == "ensure":
+                manager.ensure_servable(mid)
+            elif op == "predict":
+                manager.ensure_servable(mid)
+                runtime.predict(mid, arrays, meta.get("output_filter") or None)
+            elif op == "generate":
+                manager.ensure_servable(mid)
+                runtime.generate(
+                    mid,
+                    arrays["input_ids"],
+                    prompt_lengths=arrays["prompt_lengths"].tolist(),
+                    max_new_tokens=int(meta["max_new_tokens"]),
+                    temperature=float(meta["temperature"]),
+                    top_k=int(meta["top_k"]),
+                    seed=int(meta["seed"]),  # MUST match the leader's draw
+                )
+            elif op == "unload":
+                runtime.unload(mid)
+            else:
+                raise ValueError(f"unknown group work op {op!r}")
+
+    async def handle(self, request):
+        """aiohttp handler for POST /tpusc/groupwork."""
+        import asyncio
+
+        from aiohttp import web
+
+        body = await request.read()
+        try:
+            meta, arrays = decode_work(body)
+            await asyncio.get_running_loop().run_in_executor(
+                self._pool, self._execute, meta, arrays
+            )
+        except Exception as e:  # noqa: BLE001 - errors go back to the leader
+            log.exception("group work failed")
+            return web.json_response(
+                {"ok": False, "error": f"{type(e).__name__}: {e}"}, status=500
+            )
+        return web.json_response({"ok": True})
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+class GroupWorkServer:
+    """The follower process's work endpoint (one per process, shared by all
+    its follower groups)."""
+
+    def __init__(self, handler: GroupWorkHandler) -> None:
+        self.handler = handler
+        self._runner = None
+        self.port = 0
+
+    async def start(self, port: int, host: str = "0.0.0.0") -> int:
+        from aiohttp import web
+
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_post(WORK_PATH, self.handler.handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        self.handler.close()
+
+
+class MultiHostGroupRuntime(TPUModelRuntime):
+    """Leader-side runtime for a group spanning processes: every collective
+    op broadcasts to the followers FIRST (async), then runs locally, then
+    joins the follower acknowledgements. The per-group lock makes the op
+    stream identical on all processes."""
+
+    def __init__(
+        self,
+        *args,
+        followers: list[str],
+        group_index: int = 0,
+        work_timeout_s: float = 600.0,
+        **kwargs,
+    ) -> None:
+        super().__init__(*args, **kwargs)
+        self._followers = list(followers)
+        self._group_index = group_index
+        self._work_timeout_s = work_timeout_s
+        self._group_lock = threading.RLock()
+        self._bcast_pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(self._followers)),
+            thread_name_prefix="tpusc-bcast",
+        )
+
+    # -- broadcast plumbing -------------------------------------------------
+    def _post(self, addr: str, body: bytes) -> None:
+        req = urllib.request.Request(
+            f"http://{addr}{WORK_PATH}", data=body,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=self._work_timeout_s) as resp:
+            out = json.loads(resp.read().decode())
+        if not out.get("ok"):
+            raise RuntimeError(f"follower {addr}: {out.get('error')}")
+
+    def _broadcast(self, meta: dict, arrays: Mapping[str, np.ndarray] | None = None):
+        meta = dict(meta, group=self._group_index)
+        body = encode_work(meta, arrays)
+        return [
+            self._bcast_pool.submit(self._post, addr, body)
+            for addr in self._followers
+        ]
+
+    @staticmethod
+    def _join(futures) -> None:
+        errs = []
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+        if errs:
+            raise RuntimeError(
+                f"group followers failed: {'; '.join(str(e) for e in errs)}"
+            )
+
+    def _run_collective(self, meta, arrays, fn):
+        """Fire the broadcast, run the local half of the collective, then
+        surface any follower error. The local compute MUST start without
+        waiting for follower HTTP responses — they only arrive after the
+        followers finish the same collective.
+
+        Failure model: host-side fallible work (artifact fetch) is pushed
+        into the joinable prefetch phase (ensure_loaded below), so a
+        follower error DURING a collective means divergent device state; the
+        jax.distributed coordination service then detects the dead/failed
+        task and fails the whole group's processes for a supervisor restart
+        — there is no in-band recovery from a half-entered collective."""
+        with self._group_lock:
+            futures = self._broadcast(meta, arrays)
+            try:
+                result = fn()
+            except BaseException:
+                self._join(futures)  # follower errors usually explain ours
+                raise
+            self._join(futures)
+            return result
+
+    # -- collective ops -----------------------------------------------------
+    def ensure_loaded(self, model) -> None:
+        if self.is_loaded(model.identifier):
+            return
+        mid = model.identifier
+        with self._group_lock:
+            # phase 1 (joinable, host-side only): every process fetches the
+            # artifact to its local disk; any provider/IO failure surfaces
+            # HERE, before a single process enters the warmup collective
+            self._join(self._broadcast(
+                {"op": "prefetch", "model": mid.name, "version": mid.version}
+            ))
+            # phase 2 (collective): load + shard + warmup in lockstep
+            self._run_collective(
+                {"op": "ensure", "model": mid.name, "version": mid.version},
+                None,
+                lambda: super(MultiHostGroupRuntime, self).ensure_loaded(model),
+            )
+
+    def predict(self, model_id, inputs, output_filter=None):
+        return self._run_collective(
+            {
+                "op": "predict", "model": model_id.name,
+                "version": model_id.version, "output_filter": output_filter,
+            },
+            inputs,
+            lambda: super(MultiHostGroupRuntime, self).predict(
+                model_id, inputs, output_filter
+            ),
+        )
+
+    def generate(self, model_id, input_ids, prompt_lengths=None,
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0):
+        ids = np.asarray(input_ids, np.int32)
+        lengths = (
+            np.full((ids.shape[0],), ids.shape[1], np.int32)
+            if ids.ndim == 2 and prompt_lengths is None
+            else np.asarray(prompt_lengths if prompt_lengths is not None else [], np.int32)
+        )
+        return self._run_collective(
+            {
+                "op": "generate", "model": model_id.name,
+                "version": model_id.version, "max_new_tokens": max_new_tokens,
+                "temperature": temperature, "top_k": top_k, "seed": seed,
+            },
+            {"input_ids": ids, "prompt_lengths": lengths},
+            lambda: super(MultiHostGroupRuntime, self).generate(
+                model_id, ids, prompt_lengths=list(lengths),
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, seed=seed,
+            ),
+        )
+
+    def unload(self, model_id) -> None:
+        # unload holds no collectives, but followers must mirror it so the
+        # group's LRU states stay in lockstep (divergent eviction would make
+        # a later follower re-load run its warmup collective solo)
+        with self._group_lock:
+            futures = self._broadcast(
+                {"op": "unload", "model": model_id.name, "version": model_id.version}
+            )
+            super().unload(model_id)
+            self._join(futures)
+
+    def close(self) -> None:
+        self._bcast_pool.shutdown(wait=False, cancel_futures=True)
+        super().close()

@@ -54,8 +54,29 @@ def param_shardings(params: Any, rules: Mapping[str, Any], mesh: Mesh) -> Any:
 
 def shard_params(params: Any, rules: Mapping[str, Any], mesh: Mesh) -> Any:
     """device_put the pytree with rule-derived shardings (committed, so jit
-    respects them and partitions the computation accordingly)."""
-    return jax.device_put(params, param_shardings(params, rules, mesh))
+    respects them and partitions the computation accordingly).
+
+    On a mesh spanning processes (cross-host chip group), ``device_put`` of a
+    host array cannot address remote devices; each process instead builds the
+    global array from the shards it owns — every process calls this with the
+    SAME host params (each loads the artifact from shared storage), so the
+    assembled global array is consistent."""
+    shardings = param_shardings(params, rules, mesh)
+    if is_single_process(mesh):
+        return jax.device_put(params, shardings)
+    import numpy as np
+
+    def to_global(x, s):
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, s, lambda idx: arr[idx])
+
+    return jax.tree_util.tree_map(to_global, params, shardings)
+
+
+def is_single_process(mesh: Mesh) -> bool:
+    """True when every mesh device belongs to this process."""
+    me = jax.process_index()
+    return all(d.process_index == me for d in mesh.devices.flat)
 
 
 def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:

@@ -226,13 +226,42 @@ def _array_to_json(arr: np.ndarray) -> Any:
     return arr.tolist()
 
 
-def encode_predict_json(outputs: Mapping[str, np.ndarray], row_format: bool) -> dict[str, Any]:
+def _array_to_b64_json(arr: np.ndarray) -> dict[str, Any]:
+    """tpusc binary output encoding: raw little-endian bytes + dtype + shape.
+
+    For large tensors (an LM's full logits) this is ~4x smaller than JSON
+    number lists and decodes with one ``np.frombuffer`` instead of a
+    million-element JSON parse (VERDICT r2 next-round #4b)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype == object or arr.dtype.kind in ("S", "U"):
+        raise CodecError("base64 output encoding does not support string outputs")
+    return {
+        "b64": base64.b64encode(arr.tobytes()).decode(),
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+    }
+
+
+def encode_predict_json(
+    outputs: Mapping[str, np.ndarray], row_format: bool, encoding: str = "json"
+) -> dict[str, Any]:
     """Encode named output arrays as the ``:predict`` response body.
 
     Row: ``{"predictions": [...]}`` — single output unwrapped, multi-output as
     per-row dicts. Columnar: ``{"outputs": ...}``.
+
+    ``encoding="base64"`` (tpusc extension, requested via the body's
+    ``"output_encoding"``) always answers columnar with each tensor as
+    ``{"b64", "dtype", "shape"}``.
     """
     outputs = dict(outputs)
+    if encoding == "base64":
+        if len(outputs) == 1:
+            (arr,) = outputs.values()
+            return {"outputs": _array_to_b64_json(np.asarray(arr))}
+        return {
+            "outputs": {n: _array_to_b64_json(np.asarray(a)) for n, a in outputs.items()}
+        }
     if row_format:
         if len(outputs) == 1:
             (arr,) = outputs.values()

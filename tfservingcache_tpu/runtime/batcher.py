@@ -28,6 +28,7 @@ Models whose inputs have no named "batch" axis fall through unbatched.
 
 from __future__ import annotations
 
+import secrets
 import threading
 from dataclasses import dataclass, field
 from typing import Mapping
@@ -40,6 +41,36 @@ from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("runtime.batcher")
+
+
+def _next_bucket(n: int) -> int:
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+class _GateMap:
+    """Per-key device gates with bounded growth (shared by MicroBatcher and
+    GenerateCoalescer): serialize batches so arrivals during an in-flight
+    call accumulate into the next batch. Pruning keeps only locked gates;
+    losing an idle gate only costs a coalescing opportunity, never
+    correctness."""
+
+    def __init__(self, max_entries: int = 4096) -> None:
+        self._lock = threading.Lock()
+        self._gates: dict[tuple, threading.Lock] = {}
+        self._max = max_entries
+
+    def get(self, key: tuple) -> threading.Lock:
+        with self._lock:
+            gate = self._gates.get(key)
+            if gate is None:
+                if len(self._gates) > self._max:
+                    self._gates = {
+                        k: g for k, g in self._gates.items() if g.locked()
+                    }
+                gate = self._gates.setdefault(key, threading.Lock())
+            return gate
 
 
 @dataclass
@@ -71,9 +102,7 @@ class MicroBatcher:
         self.wait_timeout_s = wait_timeout_s
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Pending] = {}
-        # per-key device gates: serialize batches so arrivals during an
-        # in-flight call accumulate into the next batch
-        self._gates: dict[tuple, threading.Lock] = {}
+        self._gates = _GateMap()
         # signature() results are static per loaded model — cache the derived
         # axis maps so the hot path doesn't rebuild spec dicts per request
         self._axes_cache: dict[ModelId, dict[str, int] | None] = {}
@@ -144,17 +173,7 @@ class MicroBatcher:
         return (model_id, tuple(sig), tuple(output_filter or ()))
 
     def _gate(self, key: tuple) -> threading.Lock:
-        with self._lock:
-            gate = self._gates.get(key)
-            if gate is None:
-                if len(self._gates) > 4096:
-                    # bound growth across tenants/shapes; losing a gate only
-                    # costs coalescing opportunity, never correctness
-                    self._gates = {
-                        k: g for k, g in self._gates.items() if g.locked()
-                    }
-                gate = self._gates.setdefault(key, threading.Lock())
-            return gate
+        return self._gates.get(key)
 
     # -- core ---------------------------------------------------------------
     def predict(
@@ -274,3 +293,169 @@ class MicroBatcher:
                 name: np.take(arr, range(lo, hi), axis=out_axes[name])
                 for name, arr in out.items()
             }
+
+
+@dataclass
+class _GenSlot:
+    ids: np.ndarray                       # (rows, s_i) int32 prompts
+    lengths: np.ndarray                   # (rows,) true prompt lengths
+    max_new: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: np.ndarray | None = None
+    error: BaseException | None = None
+
+
+@dataclass
+class _GenPending:
+    slots: list[_GenSlot] = field(default_factory=list)
+    rows: int = 0
+    closed: bool = False
+
+
+class GenerateCoalescer:
+    """Continuous batching for ``:generate`` — the verb LM clients actually
+    call (VERDICT r2 next-round #8). Same gate design as MicroBatcher: the
+    accumulation window is the device's own busy time, so sequential traffic
+    pays nothing and saturating traffic coalesces into one prefill+decode
+    program per batch.
+
+    Coalescing key: (model, prompt-seq bucket, new-token bucket, temperature,
+    top_k) — the runtime pads to the same buckets, so joiners share one
+    compiled program; sampling params must match because one program invokes
+    one (traced) temperature/top_k for every row. Requests with an explicit
+    ``seed`` NEVER coalesce: their contract is a reproducible solo sample
+    stream, which a shared batch draw would silently break.
+    """
+
+    def __init__(
+        self,
+        runtime: BaseRuntime,
+        max_batch: int = 32,
+        wait_timeout_s: float = 600.0,
+    ) -> None:
+        self.runtime = runtime
+        self.max_batch = max_batch
+        self.wait_timeout_s = wait_timeout_s
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, _GenPending] = {}
+        self._gates = _GateMap()
+        self.batches = 0
+        self.batched_requests = 0
+
+    def _gate(self, key: tuple) -> threading.Lock:
+        return self._gates.get(key)
+
+    def generate(
+        self,
+        model_id: ModelId,
+        input_ids: np.ndarray,
+        prompt_lengths: list[int] | None = None,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int | None = None,
+    ) -> np.ndarray:
+        ids = np.asarray(input_ids, np.int32)
+        if seed is not None or ids.ndim != 2 or ids.shape[0] >= self.max_batch:
+            # seeded = reproducible solo; malformed shapes fall through so the
+            # runtime raises its own clean error
+            return self.runtime.generate(
+                model_id, ids, prompt_lengths=prompt_lengths,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k, seed=seed if seed is not None else secrets.randbits(31),
+            )
+        rows, s = ids.shape
+        if prompt_lengths is None:
+            lengths = np.full((rows,), s, np.int32)
+        else:
+            lengths = np.asarray(prompt_lengths, np.int32)
+            if lengths.shape != (rows,) or (lengths < 1).any() or (lengths > s).any():
+                # invalid per-request params must fail ONLY this request: run
+                # solo so the runtime's clean error can't poison a batch of
+                # innocent coalesced callers
+                return self.runtime.generate(
+                    model_id, ids, prompt_lengths=prompt_lengths,
+                    max_new_tokens=max_new_tokens, temperature=temperature,
+                    top_k=top_k, seed=secrets.randbits(31),
+                )
+        key = (
+            model_id, _next_bucket(s), _next_bucket(max_new_tokens),
+            float(temperature), int(top_k),
+        )
+        slot = _GenSlot(ids=ids, lengths=lengths, max_new=max_new_tokens)
+        with self._lock:
+            pend = self._pending.get(key)
+            if pend is not None and pend.rows + rows > self.max_batch:
+                pend.closed = True
+                self._pending.pop(key, None)
+                pend = None
+            leader = pend is None
+            if leader:
+                pend = _GenPending()
+                self._pending[key] = pend
+            pend.slots.append(slot)
+            pend.rows += rows
+            if pend.rows >= self.max_batch:
+                pend.closed = True
+                self._pending.pop(key, None)
+
+        if not leader:
+            if not slot.done.wait(self.wait_timeout_s):
+                raise TimeoutError(f"batched generate for {model_id} timed out")
+            if slot.error is not None:
+                raise slot.error
+            assert slot.result is not None
+            return slot.result
+
+        with self._gate(key):
+            with self._lock:
+                if not pend.closed:
+                    pend.closed = True
+                    self._pending.pop(key, None)
+            slots = pend.slots
+            try:
+                if len(slots) == 1:
+                    out = self.runtime.generate(
+                        model_id, slot.ids, prompt_lengths=list(slot.lengths),
+                        max_new_tokens=slot.max_new, temperature=temperature,
+                        top_k=top_k, seed=secrets.randbits(31),
+                    )
+                    slot.result = out
+                    return out
+                with TRACER.span(
+                    "generate_coalesce", model=str(model_id),
+                    requests=len(slots), rows=pend.rows,
+                ):
+                    s_max = max(sl.ids.shape[1] for sl in slots)
+                    cat = np.concatenate(
+                        [
+                            np.pad(sl.ids, ((0, 0), (0, s_max - sl.ids.shape[1])))
+                            for sl in slots
+                        ]
+                    )
+                    cat_len = np.concatenate([sl.lengths for sl in slots])
+                    toks = self.runtime.generate(
+                        model_id, cat, prompt_lengths=list(cat_len),
+                        max_new_tokens=max(sl.max_new for sl in slots),
+                        temperature=temperature, top_k=top_k,
+                        seed=secrets.randbits(31),
+                    )
+                    self.batches += 1
+                    self.batched_requests += len(slots)
+                    lo = 0
+                    for sl in slots:
+                        hi = lo + sl.ids.shape[0]
+                        sl.result = toks[lo:hi, : sl.max_new]
+                        lo = hi
+                assert slot.result is not None
+                return slot.result
+            except BaseException as e:
+                for sl in slots:
+                    if sl is not slot and sl.result is None and sl.error is None:
+                        sl.error = e
+                        sl.done.set()
+                raise
+            finally:
+                for sl in slots:
+                    if sl is not slot:
+                        sl.done.set()

@@ -155,7 +155,18 @@ class TPUModelRuntime(BaseRuntime):
             # (SURVEY.md §5 checkpoint/resume note)
             jax.config.update("jax_compilation_cache_dir", self.cfg.compile_cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-        self._devices = jax.devices(self.cfg.platform or None)
+        # LOCAL devices: in a multi-controller (cross-host) deployment
+        # jax.devices() includes peers' non-addressable chips — the
+        # single-device path and health probe must stay on this process's own
+        self._devices = jax.local_devices(backend=self.cfg.platform or None)
+        if mesh is not None:
+            from tfservingcache_tpu.parallel.sharding import is_single_process
+
+            # does this runtime's chip-group mesh span processes?
+            self._mp_mesh = not is_single_process(mesh)
+        else:
+            self._mp_mesh = False
+        self._replicate_out = None  # lazily-built cached reshard-identity jit
         self._resident = make_lru_cache(
             self.cfg.hbm_capacity_bytes,
             on_evict=self._on_evict,
@@ -212,7 +223,18 @@ class TPUModelRuntime(BaseRuntime):
                 entry = self._jitted_by_key.get(key)
                 created = entry is None
                 if created:
-                    jitted = jax.jit(model_def.apply)
+                    if self._mp_mesh:
+                        # cross-process group: outputs must come back fully
+                        # replicated so the leader process can read them (a
+                        # sharded output is only partially addressable here)
+                        from jax.sharding import NamedSharding, PartitionSpec
+
+                        jitted = jax.jit(
+                            model_def.apply,
+                            out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+                        )
+                    else:
+                        jitted = jax.jit(model_def.apply)
                     # refcount 0 until this model is actually resident; the
                     # failure path below removes a 0-ref entry it created
                     self._jitted_by_key[key] = (jitted, 0)
@@ -478,6 +500,20 @@ class TPUModelRuntime(BaseRuntime):
                 top_k=top_k,
                 rng=jax.random.PRNGKey(seed),
             )
+            if self._mp_mesh:
+                # force the token array fully replicated so this process can
+                # read it (inferred output sharding may split it across hosts);
+                # all group processes execute this identity in lockstep. The
+                # jitted identity is cached — a fresh lambda per call would
+                # retrace and recompile per request
+                if self._replicate_out is None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    self._replicate_out = jax.jit(
+                        lambda t: t,
+                        out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+                    )
+                toks = self._replicate_out(toks)
             toks = np.asarray(jax.device_get(toks))
         return toks[:b, :max_new_tokens]
 

@@ -54,32 +54,85 @@ class CacheNode:
         disk_cache = ModelDiskCache(cfg.cache.base_dir, cfg.cache.disk_capacity_bytes)
         self.disk_cache = disk_cache
 
+        self.work_handler = None   # follower work service (cross-host groups)
+        self.work_server = None
         if runtime is not None:
-            runtimes = [runtime]
+            runtimes = [(0, runtime)]
         else:
             from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
 
-            if cfg.mesh.chips_per_group > 1:
+            if cfg.mesh.coordinator and cfg.mesh.num_processes > 1:
+                # multi-controller deployment: rendezvous BEFORE any backend
+                # use so jax.devices() sees the whole slice (probing
+                # jax.process_count() first would itself init the backend)
                 import jax
 
-                from tfservingcache_tpu.parallel.mesh import group_mesh
+                try:
+                    jax.distributed.initialize(
+                        cfg.mesh.coordinator,
+                        num_processes=cfg.mesh.num_processes,
+                        process_id=cfg.mesh.process_id,
+                    )
+                except RuntimeError as e:
+                    if "already initialized" not in str(e).lower():
+                        raise
+            if cfg.mesh.chips_per_group > 1:
+                import numpy as np
+
+                import jax
+                from jax.sharding import Mesh
+
+                from tfservingcache_tpu.parallel.mesh import chip_groups
 
                 devices = jax.devices()
-                n_groups = max(1, len(devices) // cfg.mesh.chips_per_group)
-                runtimes = [
-                    TPUModelRuntime(
-                        cfg.serving,
-                        self.metrics,
-                        mesh=group_mesh(devices, cfg.mesh.chips_per_group, i),
-                        group=i,
+                me = jax.process_index()
+                runtimes = []
+                followers_of: dict[int, TPUModelRuntime] = {}
+                for gi, gdevs in enumerate(chip_groups(devices, cfg.mesh.chips_per_group)):
+                    procs = sorted({d.process_index for d in gdevs})
+                    if me not in procs:
+                        continue  # this process owns none of the group's chips
+                    mesh = Mesh(np.array(gdevs), ("model",))
+                    leader = gdevs[0].process_index
+                    if leader == me and len(procs) > 1:
+                        from tfservingcache_tpu.parallel.multihost import (
+                            MultiHostGroupRuntime,
+                        )
+
+                        addrs = [cfg.mesh.worker_addrs[p] for p in procs if p != me]
+                        runtimes.append((gi, MultiHostGroupRuntime(
+                            cfg.serving, self.metrics, mesh=mesh, group=gi,
+                            followers=addrs, group_index=gi,
+                        )))
+                    elif leader == me:
+                        runtimes.append((gi, TPUModelRuntime(
+                            cfg.serving, self.metrics, mesh=mesh, group=gi
+                        )))
+                    else:
+                        # follower: participate in the group's collectives via
+                        # the work service; the LEADER is the ring member
+                        followers_of[gi] = TPUModelRuntime(
+                            cfg.serving, self.metrics, mesh=mesh, group=gi
+                        )
+                if followers_of:
+                    from tfservingcache_tpu.parallel.multihost import (
+                        GroupWorkHandler,
+                        GroupWorkServer,
                     )
-                    for i in range(n_groups)
-                ]
+
+                    self.work_handler = GroupWorkHandler()
+                    for gi, rt in followers_of.items():
+                        mgr = CacheManager(
+                            provider, disk_cache, rt, self.metrics,
+                            load_timeout_s=cfg.serving.load_timeout_s,
+                        )
+                        self.work_handler.register(gi, mgr, rt)
+                    self.work_server = GroupWorkServer(self.work_handler)
             else:
-                runtimes = [TPUModelRuntime(cfg.serving, self.metrics)]
+                runtimes = [(0, TPUModelRuntime(cfg.serving, self.metrics))]
 
         self.groups: list[ServingGroup] = []
-        for i, rt in enumerate(runtimes):
+        for pos, (i, rt) in enumerate(runtimes):
             manager = CacheManager(
                 provider, disk_cache, rt, self.metrics,
                 load_timeout_s=cfg.serving.load_timeout_s,
@@ -90,13 +143,13 @@ class CacheNode:
                 batch_max_size=cfg.serving.batch_max_size,
             )
             # every group records into the SHARED Metrics registry (request/
-            # error/latency counters must cover all groups); only group 0
-            # mounts the /metrics exposition endpoint for the host
+            # error/latency counters must cover all groups); only the first
+            # local group mounts the /metrics exposition endpoint for the host
             rest = RestServingServer(
                 backend,
                 self.metrics,
                 require_version=False,
-                metrics_path=cfg.metrics.path if i == 0 else None,
+                metrics_path=cfg.metrics.path if pos == 0 else None,
                 metrics_scrape_targets=cfg.metrics.scrape_targets,
             )
             grpc = GrpcServingServer(
@@ -116,13 +169,27 @@ class CacheNode:
 
     async def start(self) -> tuple[int, int]:
         """Start every group's servers. Group i binds base_port + i (or an
-        ephemeral port when the base is 0). Returns group 0's ports."""
+        ephemeral port when the base is 0). Returns the first local group's
+        ports (0, 0 for a pure-follower process)."""
         for g in self.groups:
             rest_base = self.cfg.cache_node.rest_port
             grpc_base = self.cfg.cache_node.grpc_port
             g.rest_port = await g.rest.start(rest_base + g.index if rest_base else 0)
             g.grpc_port = await g.grpc.start(grpc_base + g.index if grpc_base else 0)
+        if self.work_server is not None:
+            # follower work endpoint: advertised to leaders via
+            # mesh.worker_addrs[process_id]
+            me = self.cfg.mesh.process_id
+            addrs = self.cfg.mesh.worker_addrs
+            port = 0
+            if me < len(addrs) and ":" in addrs[me]:
+                port = int(addrs[me].rsplit(":", 1)[1])
+            bound = await self.work_server.start(port)
+            log.info("group work service on :%d (follower groups %s)",
+                     bound, self.work_handler.group_indexes)
         self._health_task = asyncio.create_task(self._health_loop())
+        if not self.groups:
+            return 0, 0
         return self.groups[0].rest_port, self.groups[0].grpc_port
 
     def is_healthy(self) -> bool:
@@ -143,6 +210,8 @@ class CacheNode:
             await g.rest.close()
             await g.grpc.close()
             g.manager.close()
+        if self.work_server is not None:
+            await self.work_server.close()
 
 
 async def serve(cfg: Config) -> None:
