@@ -190,3 +190,63 @@ def test_bert_non_power_of_two_max_seq_served(tmp_path):
             )
     finally:
         rt.close()
+
+
+def test_artifact_v2_roundtrip_and_v1_compat(tmp_path):
+    """tpusc.v2 packed artifacts round-trip exactly (zero-copy manifest
+    views), legacy tpusc.v1 msgpack artifacts stay readable, and a corrupt
+    manifest is rejected loudly."""
+    import json
+    import os
+
+    import jax
+    from flax import serialization
+
+    from tfservingcache_tpu.models.registry import (
+        ARTIFACT_FORMAT,
+        MODEL_JSON,
+        PARAMS_FILE,
+        ArtifactError,
+        build,
+        load_artifact,
+        save_artifact,
+    )
+
+    cfg = {"vocab_size": 64, "d_model": 32, "n_layers": 2, "n_heads": 2,
+           "n_kv_heads": 1, "d_ff": 64, "max_seq": 32, "dtype": "bfloat16"}
+    model = build("transformer_lm", cfg)
+    params = jax.device_get(model.init(jax.random.PRNGKey(0)))
+    dest = str(tmp_path / "m" / "1")
+    save_artifact(dest, model, params)
+    meta = json.load(open(os.path.join(dest, MODEL_JSON)))
+    assert meta["format"] == ARTIFACT_FORMAT == "tpusc.v2"
+    assert os.path.exists(os.path.join(dest, "params.bin"))
+    md, loaded = load_artifact(dest)
+    # bf16 cast applied at save; structure (incl. list-of-layers) restored
+    assert isinstance(loaded["layers"], list) and len(loaded["layers"]) == 2
+    want = jax.tree_util.tree_map(
+        lambda x: np.asarray(x).astype(np.asarray(x).dtype), params
+    )
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(loaded)[0][:4],
+        jax.tree_util.tree_flatten_with_path(want)[0][:4],
+    ):
+        assert np.asarray(a).shape == np.asarray(b).shape
+
+    # v1 msgpack artifact still loads
+    dest1 = str(tmp_path / "old" / "1")
+    os.makedirs(dest1)
+    json.dump(
+        {"format": "tpusc.v1", "family": "transformer_lm", "config": cfg},
+        open(os.path.join(dest1, MODEL_JSON), "w"),
+    )
+    with open(os.path.join(dest1, PARAMS_FILE), "wb") as f:
+        f.write(serialization.to_bytes(params))
+    _, old = load_artifact(dest1)
+    assert isinstance(old["layers"], list) and len(old["layers"]) == 2
+
+    # corrupt manifest -> ArtifactError, not garbage params
+    meta["params"]["manifest"][0]["nbytes"] += 1
+    json.dump(meta, open(os.path.join(dest, MODEL_JSON), "w"))
+    with pytest.raises(ArtifactError, match="corrupt"):
+        load_artifact(dest)

@@ -1,4 +1,6 @@
-"""KV-cached autoregressive generation for the transformer_lm family.
+"""KV-cached autoregressive generation for the decoder-LM families
+(transformer_lm, moe_lm — both share the attention/cache layout; the FFN
+half is pluggable: dense silu-gate MLP vs routed expert block).
 
 No reference counterpart (the reference proxies opaque Predict calls —
 SURVEY.md §5); generation is where a TPU-native LM server must not re-run
@@ -65,7 +67,7 @@ def _sample(logits, rng, temperature, top_k):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg_key", "max_new_tokens"),
+    static_argnames=("cfg_key", "max_new_tokens", "family"),
 )
 def _generate_jit(
     params,
@@ -77,6 +79,7 @@ def _generate_jit(
     *,
     cfg_key,
     max_new_tokens: int,
+    family: str = "transformer_lm",
 ):
     cfg = dict(cfg_key)
     b, s_max = input_ids.shape
@@ -87,7 +90,7 @@ def _generate_jit(
     # per-example forward; padding positions write junk K/V but the per-step
     # mask keeps them invisible until overwritten
     logits, cache = _forward_cached_dyn(
-        params, input_ids, cache, jnp.zeros((b,), jnp.int32), cfg
+        params, input_ids, cache, jnp.zeros((b,), jnp.int32), cfg, family
     )
     # last REAL prompt token's logits seed the first sampled token
     last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
@@ -103,7 +106,7 @@ def _generate_jit(
 
     def _forward_cached_one(params, tok, cache, pos, cfg):
         # single-token step at per-example positions ``pos`` (B,)
-        return _forward_cached_dyn(params, tok[:, None], cache, pos, cfg)
+        return _forward_cached_dyn(params, tok[:, None], cache, pos, cfg, family)
 
     (cache, _, _, _), toks = jax.lax.scan(
         step, (cache, tok, prompt_len, rng), None, length=max_new_tokens
@@ -111,7 +114,26 @@ def _generate_jit(
     return jnp.transpose(toks, (1, 0))  # (B, max_new_tokens)
 
 
-def _forward_cached_dyn(params, input_ids, cache, start_pos, cfg):
+def _ffn_block(layer: dict, x, cfg: dict, family: str, dtype):
+    """The family-specific second half of a decoder layer (input is the
+    residual stream BEFORE its norm; returns the residual delta)."""
+    h = _rmsnorm(x, layer["ln2"])
+    if family == "moe_lm":
+        from tfservingcache_tpu.models.moe_lm import _moe_block
+
+        moe = {
+            "router": layer["moe"]["router"],  # routing stays f32
+            "w1": layer["moe"]["w1"].astype(dtype),
+            "w2": layer["moe"]["w2"].astype(dtype),
+        }
+        y, _aux = _moe_block(moe, h, cfg)  # aux loss is a training-only signal
+        return y
+    mlp = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["mlp"])
+    return (jax.nn.silu(h @ mlp["w1"]) * (h @ mlp["w3"])) @ mlp["w2"]
+
+
+def _forward_cached_dyn(params, input_ids, cache, start_pos, cfg,
+                        family: str = "transformer_lm"):
     """Like _forward_cached but with PER-EXAMPLE start positions (B,) —
     needed because prompts in one batch have different true lengths."""
     dtype = jnp.dtype(cfg["dtype"])
@@ -159,9 +181,7 @@ def _forward_cached_dyn(params, input_ids, cache, start_pos, cfg):
         out = out.reshape(b, n_heads, s_len, d).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, s_len, cfg["d_model"])
         x = x + out @ attn["wo"]
-        mlp = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["mlp"])
-        hh = _rmsnorm(x, layer["ln2"])
-        x = x + (jax.nn.silu(hh @ mlp["w1"]) * (hh @ mlp["w3"])) @ mlp["w2"]
+        x = x + _ffn_block(layer, x, cfg, family, dtype)
     x = _rmsnorm(x, params["ln_f"])
     logits = (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
     return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
@@ -192,11 +212,14 @@ def generate(
     """Generate ``max_new_tokens`` per row of ``input_ids`` (B, S prompt,
     right-padded to a common S; ``prompt_lengths`` gives true lengths).
 
-    Only decoder-LM families with the transformer_lm parameter layout are
-    supported. Returns (B, max_new_tokens) int32 token ids.
+    Decoder-LM families sharing the transformer_lm attention/cache layout
+    are supported (transformer_lm, moe_lm). Returns (B, max_new_tokens)
+    int32 token ids.
     """
-    if model_def.family != "transformer_lm":
-        raise ValueError(f"generation supports transformer_lm, not {model_def.family!r}")
+    if model_def.family not in ("transformer_lm", "moe_lm"):
+        raise ValueError(
+            f"generation supports transformer_lm/moe_lm, not {model_def.family!r}"
+        )
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, s = input_ids.shape
     if prompt_lengths is None:
@@ -220,4 +243,5 @@ def generate(
         jnp.asarray(top_k, jnp.int32),
         cfg_key=cfg_key,
         max_new_tokens=max_new_tokens,
+        family=model_def.family,
     )

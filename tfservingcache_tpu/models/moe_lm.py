@@ -12,6 +12,12 @@ one-hot dispatch/combine tensors contracted with einsum — keeps every shape
 static under jit (no data-dependent gather), trades a capacity-factor bound
 (dropped tokens pass through the residual) for MXU-friendly dense matmuls.
 Routing runs in f32; expert FFNs in bf16.
+
+Serving caveat inherent to capacity routing: expert capacity is computed
+over the whole flattened (padded) batch, so which tokens drop depends on
+batch composition — outputs are deterministic per padded shape but NOT
+batch-composition-invariant. The generate coalescer therefore never
+co-batches moe_lm requests (runtime/batcher.py).
 """
 
 from __future__ import annotations

@@ -6,8 +6,15 @@ versioned artifact directory (same ``<base>/<name>/<version>/`` layout the
 protocol and providers assume — reference diskmodelprovider.go:20-44):
 
     <name>/<version>/
-      model.json       — {"format": "tpusc.v1", "family": ..., "config": ...}
-      params.msgpack   — flax msgpack of the parameter pytree
+      model.json       — {"format": "tpusc.v2", "family": ..., "config": ...,
+                          "params": {"file": "params.bin", "manifest": [...]}}
+      params.bin       — raw little-endian leaf bytes, grouped by dtype,
+                         16-byte-aligned offsets per the manifest
+
+v2 rationale (cold path = the product): one sequential read, zero-copy
+views straight into the packed host->HBM transfer
+(runtime.packed_device_put) — no msgpack parse, and a multi-GB llama-class
+artifact can stream. ``tpusc.v1`` (flax msgpack) artifacts remain readable.
 
 ``family`` selects a builder registered here; the builder returns a
 ``ModelDef`` whose ``apply`` is a pure jittable function — everything the
@@ -24,9 +31,11 @@ from typing import Any, Callable, Mapping
 
 import numpy as np
 
-ARTIFACT_FORMAT = "tpusc.v1"
+ARTIFACT_FORMAT = "tpusc.v2"
+ARTIFACT_FORMAT_V1 = "tpusc.v1"
 MODEL_JSON = "model.json"
-PARAMS_FILE = "params.msgpack"
+PARAMS_FILE = "params.msgpack"     # v1 (read-compat)
+PARAMS_BIN = "params.bin"          # v2
 
 
 @dataclass(frozen=True)
@@ -171,9 +180,24 @@ class ArtifactError(Exception):
     pass
 
 
+def _leaf_path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+_ALIGN = 16  # every leaf offset 16-byte aligned: valid frombuffer views for
+             # any dtype, and friendly to vectorized host copies
+
+
 def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
     import jax
-    from flax import serialization
 
     os.makedirs(dest_dir, exist_ok=True)
     if model.store_param_dtype:
@@ -184,40 +208,107 @@ def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
             return a.astype(nd) if a.dtype.kind == "f" and a.dtype != nd else a
 
         params = jax.tree_util.tree_map(cast, params)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    # group by dtype so the runtime's per-dtype packed transfer reads
+    # contiguous file segments. dtype NAME, not .str: extension dtypes
+    # (bfloat16) stringify to the void '|V2' under .str and would not
+    # round-trip through np.dtype()
+    flat = sorted(
+        enumerate(flat), key=lambda e: (np.asarray(e[1][1]).dtype.name, e[0])
+    )
+    manifest = []
+    offset = 0
+    # leaves stream straight to disk — a llama-class artifact must not hold
+    # a second full copy of its params in host memory during export
+    with open(os.path.join(dest_dir, PARAMS_BIN), "wb") as f:
+        for _, (path, leaf) in flat:
+            a = np.ascontiguousarray(np.asarray(leaf))
+            pad = (-offset) % _ALIGN
+            if pad:
+                f.write(b"\0" * pad)
+                offset += pad
+            manifest.append(
+                {
+                    "path": _leaf_path_str(path),
+                    "dtype": a.dtype.name,
+                    "shape": list(a.shape),
+                    "offset": offset,
+                    "nbytes": a.nbytes,
+                }
+            )
+            # tobytes, not .data: extension dtypes (bfloat16) have no buffer
+            # protocol; this copies one leaf at a time, never the whole tree
+            f.write(a.tobytes())
+            offset += a.nbytes
     meta = {
         "format": ARTIFACT_FORMAT,
         "family": model.family,
         "config": model.config,
         "param_dtype": model.store_param_dtype,
+        "params": {"file": PARAMS_BIN, "manifest": manifest},
         "signature": {
             "inputs": {k: [v.dtype, list(v.shape)] for k, v in model.input_spec.items()},
             "outputs": {k: [v.dtype, list(v.shape)] for k, v in model.output_spec.items()},
             "method_name": model.method_name,
         },
     }
+    # model.json LAST: its presence marks the artifact complete (providers
+    # stage into unique dirs, but a direct writer gets the same safety)
     with open(os.path.join(dest_dir, MODEL_JSON), "w") as f:
         json.dump(meta, f, indent=1)
-    with open(os.path.join(dest_dir, PARAMS_FILE), "wb") as f:
-        f.write(serialization.to_bytes(params))
     return dest_dir
 
 
 def load_artifact(path: str) -> tuple[ModelDef, Any]:
     """-> (ModelDef, params pytree). Raises ArtifactError on malformed dirs."""
-    from flax import serialization
-
     meta_path = os.path.join(path, MODEL_JSON)
     if not os.path.exists(meta_path):
         raise ArtifactError(f"not a TPUSavedModel artifact (no {MODEL_JSON}): {path}")
     with open(meta_path) as f:
         meta = json.load(f)
-    if meta.get("format") != ARTIFACT_FORMAT:
-        raise ArtifactError(f"unsupported artifact format {meta.get('format')!r} in {path}")
+    fmt = meta.get("format")
+    if fmt == ARTIFACT_FORMAT_V1:
+        from flax import serialization
+
+        model = build(meta["family"], meta.get("config"))
+        with open(os.path.join(path, PARAMS_FILE), "rb") as f:
+            # msgpack_restore avoids needing an init()-built template
+            params = serialization.msgpack_restore(f.read())
+        return model, _restore_lists(params)
+    if fmt != ARTIFACT_FORMAT:
+        raise ArtifactError(f"unsupported artifact format {fmt!r} in {path}")
     model = build(meta["family"], meta.get("config"))
-    with open(os.path.join(path, PARAMS_FILE), "rb") as f:
-        # msgpack_restore avoids needing an init()-built template at load time
-        params = serialization.msgpack_restore(f.read())
-    return model, _restore_lists(params)
+    spec = meta.get("params") or {}
+    bin_path = os.path.join(path, spec.get("file", PARAMS_BIN))
+    manifest = spec.get("manifest")
+    if manifest is None or not os.path.exists(bin_path):
+        raise ArtifactError(f"artifact missing params manifest or {bin_path}")
+    import ml_dtypes  # registers bfloat16/float8 names with np.dtype
+
+    del ml_dtypes
+    # ONE sequential read; every leaf is a zero-copy aligned view into it
+    blob = np.fromfile(bin_path, dtype=np.uint8)
+    nested: dict[str, Any] = {}
+    for ent in manifest:
+        dt = np.dtype(ent["dtype"])
+        n = int(np.prod(ent["shape"])) if ent["shape"] else 1
+        off, nbytes = int(ent["offset"]), int(ent["nbytes"])
+        if nbytes != n * dt.itemsize or off + nbytes > blob.nbytes:
+            raise ArtifactError(
+                f"corrupt manifest entry {ent['path']!r} in {bin_path}"
+            )
+        arr = np.frombuffer(blob.data, dtype=dt, count=n, offset=off).reshape(
+            ent["shape"]
+        )
+        if ent["path"] == "":
+            return model, arr  # params was a single bare array
+        node = nested
+        parts = ent["path"].split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return model, _restore_lists(nested)
 
 
 def _restore_lists(tree: Any) -> Any:

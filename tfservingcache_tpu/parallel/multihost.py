@@ -30,6 +30,7 @@ from __future__ import annotations
 import io
 import json
 import threading
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Mapping
@@ -189,8 +190,17 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             f"http://{addr}{WORK_PATH}", data=body,
             headers={"Content-Type": "application/octet-stream"},
         )
-        with urllib.request.urlopen(req, timeout=self._work_timeout_s) as resp:
-            out = json.loads(resp.read().decode())
+        try:
+            with urllib.request.urlopen(req, timeout=self._work_timeout_s) as resp:
+                out = json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            # the follower's 500 carries the actual cause in its JSON body —
+            # surface it, not just "HTTP Error 500"
+            try:
+                detail = json.loads(e.read().decode()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                detail = str(e)
+            raise RuntimeError(f"follower {addr}: {detail}") from None
         if not out.get("ok"):
             raise RuntimeError(f"follower {addr}: {out.get('error')}")
 

@@ -445,10 +445,16 @@ class LocalServingBackend(ServingBackend):
             return self._predictor.predict(model_id, arrays, out_filter or None), row
 
         outputs, row = await self._run(lambda: run())
-        try:
-            body = json.dumps(
+
+        def encode() -> bytes:
+            # encoding large float tensors as JSON costs ~10 ms+ — keep it in
+            # the executor so the event loop stays free to admit requests
+            return json.dumps(
                 codec.encode_predict_json(outputs, row_format=row, encoding=encoding)
             ).encode()
+
+        try:
+            body = await self._run(encode)
         except codec.CodecError as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
         return RestResponse(status=200, body=body)
@@ -513,10 +519,10 @@ class LocalServingBackend(ServingBackend):
                 tokens = await asyncio.wait_for(self._run(run), timeout)
             else:
                 tokens = await self._run(run)
-        except TimeoutError:
-            # with the deadline disabled this branch can still fire: the
-            # coalescer's own follower wait raises builtin TimeoutError
-            # (== asyncio.TimeoutError on 3.11+)
+        except (TimeoutError, asyncio.TimeoutError):
+            # both spellings: asyncio.TimeoutError is the builtin only since
+            # 3.11, and with the deadline disabled this branch can still fire
+            # via the coalescer's own follower wait (builtin TimeoutError)
             bound = f"{timeout:.0f}s" if timeout else "the batch-wait"
             raise BackendError(
                 f"generate for {model_id} exceeded {bound} deadline",

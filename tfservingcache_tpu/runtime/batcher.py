@@ -16,9 +16,16 @@ runs as ONE runtime.predict, outputs split back by each caller's row count.
 The accumulation window is therefore exactly the device's own busy time:
 
   - strictly sequential traffic acquires an uncontended gate and runs
-    immediately — ZERO added latency, which is why batching defaults on;
+    immediately — no timed wait is ever inserted (the added latency is the
+    gate bookkeeping itself, small but not literally zero);
   - saturating traffic coalesces into device-call-sized batches without any
     window-length tuning (the classic latency/throughput knob dissolves).
+
+Whether coalescing wins over independent dispatch is an empirical, shape-
+dependent question — bench.py measures warm QPS batcher on vs off with
+varied payloads; round 2's "batcher loses 31%" verdict was measured with
+identical repeated payloads a transport cache could answer, so trust only
+the varied-payload numbers.
 
 Calls are thread-blocking by design — they arrive on the protocol backend's
 executor threads (protocol/local_backend.py), never on the event loop.
@@ -43,10 +50,9 @@ from tfservingcache_tpu.utils.tracing import TRACER
 log = get_logger("runtime.batcher")
 
 
-def _next_bucket(n: int) -> int:
-    if n <= 1:
-        return 1
-    return 1 << (n - 1).bit_length()
+# the coalescer predicts which runtime compile bucket a request lands in —
+# it must be the runtime's own bucketing function, not a copy that can drift
+from tfservingcache_tpu.runtime.model_runtime import next_bucket as _next_bucket
 
 
 class _GateMap:
@@ -356,9 +362,18 @@ class GenerateCoalescer:
         seed: int | None = None,
     ) -> np.ndarray:
         ids = np.asarray(input_ids, np.int32)
-        if seed is not None or ids.ndim != 2 or ids.shape[0] >= self.max_batch:
+        family = getattr(self.runtime, "family_of", lambda _m: None)(model_id)
+        if (
+            seed is not None
+            or ids.ndim != 2
+            or ids.shape[0] >= self.max_batch
+            or family != "transformer_lm"
+        ):
             # seeded = reproducible solo; malformed shapes fall through so the
-            # runtime raises its own clean error
+            # runtime raises its own clean error; capacity-routed families
+            # (moe_lm) never co-batch — expert capacity is computed over the
+            # whole flattened batch, so co-batched strangers would change
+            # which of THIS request's tokens the router drops
             return self.runtime.generate(
                 model_id, ids, prompt_lengths=prompt_lengths,
                 max_new_tokens=max_new_tokens, temperature=temperature,

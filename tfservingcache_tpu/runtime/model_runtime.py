@@ -443,9 +443,9 @@ class TPUModelRuntime(BaseRuntime):
         loaded = self._resident.get(model_id)
         if loaded is None:
             raise ModelNotLoadedError(f"model {model_id} is not loaded")
-        if loaded.model_def.family != "transformer_lm":
+        if loaded.model_def.family not in ("transformer_lm", "moe_lm"):
             raise RuntimeError_(
-                f"generate is supported for transformer_lm models, "
+                f"generate is supported for transformer_lm/moe_lm models, "
                 f"not {loaded.model_def.family!r}"
             )
         from tfservingcache_tpu.models.generation import generate as gen
@@ -551,6 +551,13 @@ class TPUModelRuntime(BaseRuntime):
 
     def is_loaded(self, model_id: ModelId) -> bool:
         return self._resident.get(model_id, touch=False) is not None
+
+    def family_of(self, model_id: ModelId) -> str | None:
+        """Family of a resident model (None when not loaded) — the generate
+        coalescer keys on this: capacity-routed families (moe_lm) must not
+        co-batch, their expert routing depends on batch composition."""
+        loaded = self._resident.get(model_id, touch=False)
+        return None if loaded is None else loaded.model_def.family
 
     def signature(self, model_id: ModelId):
         loaded = self._resident.get(model_id, touch=False)

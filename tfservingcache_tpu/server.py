@@ -56,6 +56,7 @@ class CacheNode:
 
         self.work_handler = None   # follower work service (cross-host groups)
         self.work_server = None
+        self._follower_managers: list[CacheManager] = []
         if runtime is not None:
             runtimes = [(0, runtime)]
         else:
@@ -127,6 +128,7 @@ class CacheNode:
                             load_timeout_s=cfg.serving.load_timeout_s,
                         )
                         self.work_handler.register(gi, mgr, rt)
+                        self._follower_managers.append(mgr)
                     self.work_server = GroupWorkServer(self.work_handler)
             else:
                 runtimes = [(0, TPUModelRuntime(cfg.serving, self.metrics))]
@@ -212,6 +214,8 @@ class CacheNode:
             g.manager.close()
         if self.work_server is not None:
             await self.work_server.close()
+        for mgr in self._follower_managers:
+            mgr.close()
 
 
 async def serve(cfg: Config) -> None:

@@ -47,7 +47,27 @@ async def main() -> int:
     g.add_argument("--classify", action="store_true", help="empty-example Classify (reference testclient flow)")
     g.add_argument("--status", action="store_true", help="ModelService.GetModelStatus")
     g.add_argument("--metadata", action="store_true")
+    g.add_argument(
+        "--generate", metavar="JSON",
+        help='REST :generate body, e.g. \'{"input_ids": [[1,2,3]], "max_new_tokens": 8}\''
+        " (--target must be a REST port for this verb)",
+    )
     args = p.parse_args()
+
+    if args.generate:
+        # :generate is a tpusc REST extension — no gRPC shape exists
+        import urllib.request
+
+        url = f"http://{args.target}/v1/models/{args.model}"
+        if args.version is not None:
+            url += f"/versions/{args.version}"
+        req = urllib.request.Request(
+            url + ":generate", data=args.generate.encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            print(resp.read().decode())
+        return 0
 
     channel = make_channel(args.target)
     stub = ServingStub(channel)
