@@ -134,3 +134,30 @@ def test_encode_base64_binary_outputs():
     # strings can't be binary-encoded
     with pytest.raises(CodecError):
         _array_to_b64_json(np.array([b"x"], dtype=object))
+
+
+def test_decode_binary_tensor_inputs():
+    """Request-side binary tensors: {"b64", "dtype", "shape"} decodes with
+    one frombuffer (mirror of output_encoding="base64")."""
+    import base64
+
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    spec = {
+        "b64": base64.b64encode(x.tobytes()).decode(),
+        "dtype": "float32",
+        "shape": [3, 4],
+    }
+    arrays, _ = decode_predict_json({"inputs": {"x": spec}}, {"x": np.dtype(np.float32)})
+    np.testing.assert_array_equal(arrays["x"], x)
+    # dtype coercion to the model's input spec
+    arrays2, _ = decode_predict_json({"inputs": {"x": spec}}, {"x": np.dtype(np.int32)})
+    assert arrays2["x"].dtype == np.int32
+    # plain {"b64": ...} (TF string-bytes form) still decodes as bytes
+    arrays3, _ = decode_predict_json(
+        {"inputs": {"s": {"b64": base64.b64encode(b"hi").decode()}}}, {}
+    )
+    assert arrays3["s"].dtype == object
+    # wrong byte count -> CodecError
+    bad = dict(spec, shape=[2, 4])
+    with pytest.raises(CodecError, match="bytes"):
+        decode_predict_json({"inputs": {"x": bad}}, {})

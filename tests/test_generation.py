@@ -386,6 +386,10 @@ def test_generate_coalescer_concurrent_stress(tmp_path):
         for t in ts:
             t.join()
         assert not errors, errors
+        # the stress is pointless if nothing ever coalesced: with 8 threads
+        # funneling 24 requests through per-key gates, at least one batch
+        # must have formed
+        assert gc.batches >= 1
         for g, w in zip(got, want):
             np.testing.assert_array_equal(g, w)
     finally:

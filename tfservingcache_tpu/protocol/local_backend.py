@@ -75,11 +75,14 @@ class LocalServingBackend(ServingBackend):
                 MicroBatcher,
             )
 
-            self._predictor = MicroBatcher(manager.runtime, max_batch=batch_max_size)
+            self._predictor = MicroBatcher(
+                manager.runtime, max_batch=batch_max_size, metrics=manager.metrics
+            )
             # concurrent :generate requests with matching buckets + sampling
             # params coalesce into one prefill+decode program
             self._generator = GenerateCoalescer(
-                manager.runtime, max_batch=min(batch_max_size, 32)
+                manager.runtime, max_batch=min(batch_max_size, 32),
+                metrics=manager.metrics,
             )
         else:
             self._predictor = manager.runtime

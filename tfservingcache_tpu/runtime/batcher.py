@@ -101,11 +101,13 @@ class MicroBatcher:
         runtime: BaseRuntime,
         max_batch: int = 64,
         wait_timeout_s: float = 600.0,
+        metrics=None,
     ) -> None:
         self.runtime = runtime
         self.max_batch = max_batch
         # generous: a follower may sit behind the leader's cold jit compile
         self.wait_timeout_s = wait_timeout_s
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Pending] = {}
         self._gates = _GateMap()
@@ -253,6 +255,9 @@ class MicroBatcher:
                     out = self.runtime.predict(model_id, cat, output_filter)
                     self.batches += 1
                     self.batched_requests += len(slots)
+                    if self.metrics is not None:
+                        self.metrics.coalesced_batches.labels("predict").inc()
+                        self.metrics.coalesced_requests.labels("predict").inc(len(slots))
                     self._scatter(model_id, slots, out)
                 assert slot.result is not None
                 return slot.result
@@ -338,10 +343,12 @@ class GenerateCoalescer:
         runtime: BaseRuntime,
         max_batch: int = 32,
         wait_timeout_s: float = 600.0,
+        metrics=None,
     ) -> None:
         self.runtime = runtime
         self.max_batch = max_batch
         self.wait_timeout_s = wait_timeout_s
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._pending: dict[tuple, _GenPending] = {}
         self._gates = _GateMap()
@@ -457,6 +464,9 @@ class GenerateCoalescer:
                     )
                     self.batches += 1
                     self.batched_requests += len(slots)
+                    if self.metrics is not None:
+                        self.metrics.coalesced_batches.labels("generate").inc()
+                        self.metrics.coalesced_requests.labels("generate").inc(len(slots))
                     lo = 0
                     for sl in slots:
                         hi = lo + sl.ids.shape[0]

@@ -90,6 +90,16 @@ class Metrics:
         self.evictions = Counter(
             "tpusc_evictions_total", "Evictions", ["tier"], registry=r
         )
+        # continuous batching observability: how often requests coalesce and
+        # how many ride each device call (kind = predict | generate)
+        self.coalesced_batches = Counter(
+            "tpusc_coalesced_batches", "Multi-request device calls",
+            ["kind"], registry=r,
+        )
+        self.coalesced_requests = Counter(
+            "tpusc_coalesced_requests", "Requests served via a coalesced call",
+            ["kind"], registry=r,
+        )
 
     def model_label(self, name: str, version: int | str) -> str:
         if self.model_labels:

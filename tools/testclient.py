@@ -54,7 +54,7 @@ async def main() -> int:
     )
     args = p.parse_args()
 
-    if args.generate:
+    if args.generate is not None:
         # :generate is a tpusc REST extension — no gRPC shape exists
         import urllib.request
 
