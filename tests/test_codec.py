@@ -161,3 +161,37 @@ def test_decode_binary_tensor_inputs():
     bad = dict(spec, shape=[2, 4])
     with pytest.raises(CodecError, match="bytes"):
         decode_predict_json({"inputs": {"x": bad}}, {})
+
+
+def test_binary_tensor_bf16_roundtrip_and_row_format():
+    import base64
+
+    import ml_dtypes
+
+    bf = np.dtype(ml_dtypes.bfloat16)
+    x = np.arange(8).astype(bf).reshape(2, 4)
+    spec = {"b64": base64.b64encode(x.tobytes()).decode(), "dtype": "bfloat16",
+            "shape": [2, 4]}
+    # the server's own base64 output form round-trips back in
+    arrays, _ = decode_predict_json({"inputs": {"x": spec}}, {"x": bf})
+    assert arrays["x"].dtype == bf
+    np.testing.assert_array_equal(
+        arrays["x"].astype(np.float32), x.astype(np.float32)
+    )
+    # row format: one spec per instance, stacked
+    row = {"b64": base64.b64encode(np.ones(4, np.float32).tobytes()).decode(),
+           "dtype": "float32", "shape": [4]}
+    arrays2, _ = decode_predict_json(
+        {"instances": [{"x": row}, {"x": row}]}, {"x": np.dtype(np.float32)}
+    )
+    assert arrays2["x"].shape == (2, 4)
+    # ragged rows -> CodecError (400), never a TypeError 500
+    row8 = {"b64": base64.b64encode(np.ones(8, np.float32).tobytes()).decode(),
+            "dtype": "float32", "shape": [8]}
+    with pytest.raises(CodecError):
+        decode_predict_json({"instances": [{"x": row}, {"x": row8}]}, {})
+    # structured void stays rejected
+    with pytest.raises(CodecError, match="numeric"):
+        decode_predict_json(
+            {"inputs": {"x": {"b64": "AAAA", "dtype": "V4", "shape": [1]}}}, {}
+        )

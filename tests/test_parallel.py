@@ -217,3 +217,55 @@ def test_pipeline_rejects_stage_count_mismatch():
     stacked = stack_stage_params([{"w": jnp.eye(4)} for _ in range(8)])
     with pytest.raises(ValueError, match="mesh stages"):
         pipeline_apply(lambda p, x: x @ p["w"], stacked, jnp.ones((8, 4)), mesh, n_microbatches=4)
+
+
+def test_ring_attention_serving_path(tmp_path):
+    """Long-context config ("attention": "ring") served on an 8-chip group:
+    the runtime binds the group mesh into the family's apply, the sequence
+    axis rides the ring (weights replicated), and logits match an unsharded
+    runtime. A bucket shorter than the ring falls back to regular attention
+    and must also match."""
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.models.registry import export_artifact
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+    from tfservingcache_tpu.types import Model, ModelId
+
+    cfg = {
+        "vocab_size": 128, "d_model": 64, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 4, "d_ff": 128, "max_seq": 128, "dtype": "bfloat16",
+        "attention": "ring",
+    }
+    export_artifact("transformer_lm", str(tmp_path), name="ringlm", version=1,
+                    config=cfg)
+    mesh = group_mesh(jax.devices()[:8], 8, 0)
+    rt_ring = TPUModelRuntime(ServingConfig(), mesh=mesh)
+    rt_1 = TPUModelRuntime(ServingConfig())
+    try:
+        path = str(tmp_path / "ringlm" / "1")
+        rt_ring.ensure_loaded(Model(identifier=ModelId("ringlm", 1), path=path))
+        rt_1.ensure_loaded(Model(identifier=ModelId("ref", 1), path=path))
+        # weights replicated on every group chip (ring owns the axis)
+        loaded = rt_ring._resident.get(ModelId("ringlm", 1))
+        wq = loaded.params["layers"][0]["attn"]["wq"]
+        assert len(wq.sharding.device_set) == 8
+        assert wq.sharding.is_fully_replicated
+        ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+        got = rt_ring.predict(
+            ModelId("ringlm", 1), {"input_ids": ids}, output_filter=["logits"]
+        )["logits"]
+        want = rt_1.predict(
+            ModelId("ref", 1), {"input_ids": ids}, output_filter=["logits"]
+        )["logits"]
+        np.testing.assert_allclose(got, want, atol=5e-2, rtol=5e-2)
+        # short-seq fallback (bucket 4 < ring of 8): still correct
+        short = ids[:, :3]
+        got_s = rt_ring.predict(
+            ModelId("ringlm", 1), {"input_ids": short}, output_filter=["logits"]
+        )["logits"]
+        want_s = rt_1.predict(
+            ModelId("ref", 1), {"input_ids": short}, output_filter=["logits"]
+        )["logits"]
+        np.testing.assert_allclose(got_s, want_s, atol=5e-2, rtol=5e-2)
+    finally:
+        rt_ring.close()
+        rt_1.close()

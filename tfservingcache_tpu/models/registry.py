@@ -106,6 +106,12 @@ class ModelDef:
     # artifact halves both disk reads and the host->device transfer that
     # dominates the cold-miss path.
     store_param_dtype: str | None = None
+    # mesh-aware apply factory: families whose computation itself needs the
+    # chip-group mesh (ring/context-parallel attention) set this; the runtime
+    # jit-compiles bind_mesh(mesh) instead of ``apply`` when serving on a
+    # group. Plain TP families leave it None — their sharding is declarative
+    # (partition_rules) and XLA inserts the collectives.
+    bind_mesh: Callable[[Any], Callable[[Any, Mapping[str, Any]], dict[str, Any]]] | None = None
 
 
 _REGISTRY: dict[str, Callable[[dict[str, Any]], ModelDef]] = {}

@@ -36,6 +36,11 @@ DEFAULT_CONFIG: dict[str, Any] = {
     "max_seq": 1024,
     "rope_theta": 10000.0,
     "dtype": "bfloat16",
+    # "auto" = flash kernel on TPU / jnp elsewhere. "ring" = context
+    # parallelism: the sequence axis is sharded over the serving chip group
+    # and K/V blocks rotate by ppermute (parallel/ring_attention.py) — for
+    # long-context models whose attention working set exceeds one chip.
+    "attention": "auto",
 }
 
 # llama-2-7b-class shape for multi-chip serving/benching
@@ -70,7 +75,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rot.reshape(x.shape).astype(x.dtype)
 
 
-def _attention_block(params: dict, x: jax.Array, cfg: dict) -> jax.Array:
+def _attention_block(params: dict, x: jax.Array, cfg: dict, mesh=None) -> jax.Array:
     b, s, d_model = x.shape
     n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
     head_dim = d_model // n_heads
@@ -80,9 +85,22 @@ def _attention_block(params: dict, x: jax.Array, cfg: dict) -> jax.Array:
     positions = jnp.arange(s)
     q = _rope(q, positions, cfg["rope_theta"])
     k = _rope(k, positions, cfg["rope_theta"])
-    # GQA handled inside attention (grouped K/V, never materialized via
-    # repeat — that would negate GQA's HBM saving at llama-7b scale)
-    out = attention(q, k, v, causal=True)                               # (b,h,s,hd)
+    if (
+        mesh is not None
+        and cfg.get("attention") == "ring"
+        and s % mesh.shape.get("model", 1) == 0
+        and mesh.shape.get("model", 1) > 1
+    ):
+        # context parallelism: sequence sharded over the group's chips, K/V
+        # rotating by ppermute — sequences too short for the ring (bucket <
+        # group size) fall through to regular attention below
+        from tfservingcache_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, mesh, axis="model", causal=True)
+    else:
+        # GQA handled inside attention (grouped K/V, never materialized via
+        # repeat — that would negate GQA's HBM saving at llama-7b scale)
+        out = attention(q, k, v, causal=True)                           # (b,h,s,hd)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, d_model)
     return out @ params["wo"]
 
@@ -93,7 +111,7 @@ def _mlp_block(params: dict, x: jax.Array) -> jax.Array:
     return (gate * up) @ params["w2"]
 
 
-def _forward(params: dict, input_ids: jax.Array, cfg: dict) -> jax.Array:
+def _forward(params: dict, input_ids: jax.Array, cfg: dict, mesh=None) -> jax.Array:
     dtype = jnp.dtype(cfg["dtype"])
     x = params["embed"][input_ids].astype(dtype)                        # (b,s,d)
     for layer in params["layers"]:
@@ -101,6 +119,7 @@ def _forward(params: dict, input_ids: jax.Array, cfg: dict) -> jax.Array:
             jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["attn"]),
             _rmsnorm(x, layer["ln1"]),
             cfg,
+            mesh,
         )
         x = x + _mlp_block(
             jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["mlp"]),
@@ -114,14 +133,28 @@ def _forward(params: dict, input_ids: jax.Array, cfg: dict) -> jax.Array:
 @register("transformer_lm", DEFAULT_CONFIG)
 def build(config: dict) -> ModelDef:
     cfg = config
+    ring = cfg.get("attention") == "ring"
+    if ring and cfg["n_heads"] != cfg["n_kv_heads"]:
+        raise ValueError(
+            "attention='ring' requires n_heads == n_kv_heads (the ring "
+            "rotates full K/V blocks; grouped-KV ring is not implemented)"
+        )
 
-    def apply(params, inputs):
-        # logits only: the runtime pads the sequence axis to shape buckets,
-        # and causal masking keeps valid positions exact — but any "last
-        # token" reduction would land on padding, so sampling stays client-
-        # side (or in the generate helper, which tracks true lengths).
-        logits = _forward(params, inputs["input_ids"].astype(jnp.int32), cfg)
-        return {"logits": logits}
+    def make_apply(mesh=None):
+        def apply(params, inputs):
+            # logits only: the runtime pads the sequence axis to shape
+            # buckets, and causal masking keeps valid positions exact — but
+            # any "last token" reduction would land on padding, so sampling
+            # stays client-side (or in the generate helper, which tracks
+            # true lengths).
+            logits = _forward(
+                params, inputs["input_ids"].astype(jnp.int32), cfg, mesh
+            )
+            return {"logits": logits}
+
+        return apply
+
+    apply = make_apply(None)
 
     def init(rng):
         d, v, ff = cfg["d_model"], cfg["vocab_size"], cfg["d_ff"]
@@ -167,16 +200,22 @@ def build(config: dict) -> ModelDef:
         nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
         return jnp.mean(nll)
 
-    # Megatron-style tensor parallelism over the "model" mesh axis: column-
-    # parallel QKV/W1/W3, row-parallel WO/W2 (XLA inserts the all-reduces).
-    partition_rules = {
-        "embed": (None, "model"),
-        r"layers/\d+/attn/w[qkv]": (None, "model"),
-        r"layers/\d+/attn/wo": ("model", None),
-        r"layers/\d+/mlp/w[13]": (None, "model"),
-        r"layers/\d+/mlp/w2": ("model", None),
-        r".*ln.*": (None,),
-    }
+    if ring:
+        # context parallelism owns the group's mesh axis for the SEQUENCE;
+        # weights replicate (rule matches everything -> PartitionSpec())
+        partition_rules = {r".*": ()}
+    else:
+        # Megatron-style tensor parallelism over the "model" mesh axis:
+        # column-parallel QKV/W1/W3, row-parallel WO/W2 (XLA inserts the
+        # all-reduces).
+        partition_rules = {
+            "embed": (None, "model"),
+            r"layers/\d+/attn/w[qkv]": (None, "model"),
+            r"layers/\d+/attn/wo": ("model", None),
+            r"layers/\d+/mlp/w[13]": (None, "model"),
+            r"layers/\d+/mlp/w2": ("model", None),
+            r".*ln.*": (None,),
+        }
 
     def last_token_logits(outputs, dyn_sizes):
         """Device-side slice at the last REAL position (runtime pads seq to a
@@ -210,4 +249,6 @@ def build(config: dict) -> ModelDef:
         # apply casts weights to cfg dtype anyway; storing them f32 doubled
         # the cold-path transfer (round-2 cold p50 3.14 s was ~80% device_put)
         store_param_dtype=cfg["dtype"],
+        # ring mode needs the serving group's mesh inside the computation
+        bind_mesh=make_apply if ring else None,
     )

@@ -137,18 +137,29 @@ def _json_to_value(obj: Any) -> Any:
     return obj
 
 
-def _binary_tensor_to_array(spec: Mapping[str, Any]) -> np.ndarray:
+def _is_binary_spec(v: Any) -> bool:
+    return isinstance(v, dict) and {"b64", "dtype", "shape"} <= set(v.keys())
+
+
+def _binary_tensor_to_array(
+    spec: Mapping[str, Any], dtype: np.dtype | None = None
+) -> np.ndarray:
     """tpusc binary input: {"b64": raw little-endian bytes, "dtype": name,
     "shape": [...]} — the request-side mirror of output_encoding="base64".
-    Decodes with one frombuffer instead of parsing JSON number lists."""
-    import ml_dtypes  # registers bfloat16 etc. with np.dtype
-
-    del ml_dtypes
+    Decodes with one frombuffer instead of parsing JSON number lists;
+    ``dtype`` coerces to the model's input spec in the same materialization.
+    """
     try:
         dt = np.dtype(spec["dtype"])
         shape = tuple(int(d) for d in spec["shape"])
         raw = base64.b64decode(spec["b64"])
-        if dt.kind not in "fiub" or dt.itemsize == 0:
+        # extension float dtypes (bfloat16, float8_*) report numpy kind 'V';
+        # admit them by name, reject genuinely non-numeric kinds — the
+        # server's own base64 outputs must round-trip back in
+        numeric = dt.kind in "fiub" or (
+            dt.kind == "V" and not dt.name.startswith("void") and dt.itemsize
+        )
+        if not numeric or dt.itemsize == 0:
             raise CodecError(f"binary tensors must be numeric, not {dt.name}")
         if any(d < 0 for d in shape):
             raise CodecError(f"binary tensor shape {list(shape)} has negative dims")
@@ -158,7 +169,10 @@ def _binary_tensor_to_array(spec: Mapping[str, Any]) -> np.ndarray:
                 f"binary tensor holds {len(raw)} bytes, shape {list(shape)} of "
                 f"{dt.name} needs {n * dt.itemsize}"
             )
-        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+        arr = np.frombuffer(raw, dtype=dt).reshape(shape)
+        if dtype is not None and dtype != dt:
+            return arr.astype(dtype)  # the only materialization on this path
+        return arr.copy()  # writable, detached from the request buffer
     except CodecError:
         raise
     except (KeyError, TypeError, ValueError) as e:
@@ -167,12 +181,15 @@ def _binary_tensor_to_array(spec: Mapping[str, Any]) -> np.ndarray:
 
 
 def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
-    if (
-        isinstance(value, dict)
-        and {"b64", "dtype", "shape"} <= set(value.keys())
-    ):
-        arr = _binary_tensor_to_array(value)
-        return arr.astype(dtype) if dtype is not None and arr.dtype != dtype else arr
+    if _is_binary_spec(value):
+        return _binary_tensor_to_array(value, dtype)
+    if isinstance(value, list) and value and all(_is_binary_spec(v) for v in value):
+        # row format: one binary spec per instance, stacked on a new axis 0
+        rows = [_binary_tensor_to_array(v, dtype) for v in value]
+        try:
+            return np.stack(rows)
+        except ValueError as e:
+            raise CodecError(f"binary instance rows disagree in shape: {e}") from e
     value = _json_to_value(value)
 
     def has_bytes(v: Any) -> bool:
@@ -185,6 +202,10 @@ def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
     if has_bytes(value):
         return np.array(value, dtype=object)
     arr = np.asarray(value)
+    if arr.dtype == object:
+        # mixed/ragged JSON (e.g. binary specs inconsistently nested in
+        # rows) must surface as the client's 400, not a 500 downstream
+        raise CodecError("input values are not a dense numeric tensor")
     if dtype is not None:
         arr = arr.astype(dtype)
     elif arr.dtype == np.float64:

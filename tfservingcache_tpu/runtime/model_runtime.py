@@ -219,6 +219,14 @@ class TPUModelRuntime(BaseRuntime):
             else:
                 params = packed_device_put(host_params, self._devices[0])
             key = model_def.cache_key
+            # mesh-aware families (ring/context-parallel attention) build
+            # their apply against THIS group's mesh; per-runtime jit cache
+            # means the binding can't leak across groups
+            apply_fn = (
+                model_def.bind_mesh(self.mesh)
+                if (self.mesh is not None and model_def.bind_mesh is not None)
+                else model_def.apply
+            )
             with self._jit_lock:
                 entry = self._jitted_by_key.get(key)
                 created = entry is None
@@ -230,11 +238,11 @@ class TPUModelRuntime(BaseRuntime):
                         from jax.sharding import NamedSharding, PartitionSpec
 
                         jitted = jax.jit(
-                            model_def.apply,
+                            apply_fn,
                             out_shardings=NamedSharding(self.mesh, PartitionSpec()),
                         )
                     else:
-                        jitted = jax.jit(model_def.apply)
+                        jitted = jax.jit(apply_fn)
                     # refcount 0 until this model is actually resident; the
                     # failure path below removes a 0-ref entry it created
                     self._jitted_by_key[key] = (jitted, 0)
