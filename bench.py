@@ -607,12 +607,18 @@ def run(args) -> dict:
             ).items()
         }
     )
-    # default output = last_token_logits (the out-of-box path, VERDICT r2 #4a)
+    # default output = last_token_logits (the out-of-box path, VERDICT r2 #4a);
+    # batcher on AND off — the on/off verdict must cover both families
     lm_qps = asyncio.run(
         _rest_warm_qps(lm_manager, "transformer_lm", lm_variants, args.warm_s,
                        args.clients, 0.0)
     )
     detail["transformer_lm"]["warm_rest_qps"] = round(lm_qps, 1)
+    lm_qps_b = asyncio.run(
+        _rest_warm_qps(lm_manager, "transformer_lm", lm_variants, args.warm_s,
+                       args.clients, 2.0)
+    )
+    detail["transformer_lm"]["warm_rest_qps_batch"] = round(lm_qps_b, 1)
     lm_gqps = asyncio.run(
         _grpc_warm_qps(lm_manager, lm_variants, args.warm_s, args.clients, 0.0)
     )

@@ -25,6 +25,13 @@ def main(argv: list[str] | None = None) -> int:
     exp.add_argument("dest", help="destination dir (<base>/<name>/<version> is created)")
     exp.add_argument("--name", default=None)
     exp.add_argument("--version", type=int, default=1)
+    rep = sub.add_parser(
+        "repack",
+        help="rewrite an artifact in the current format (tpusc.v1 msgpack -> "
+        "tpusc.v2 packed bin; applies the family's storage dtype)",
+    )
+    rep.add_argument("src", help="existing artifact dir (<...>/<name>/<version>)")
+    rep.add_argument("dest", help="output artifact dir")
     args = parser.parse_args(argv)
 
     cfg = load_config(args.config)
@@ -47,6 +54,12 @@ def main(argv: list[str] | None = None) -> int:
 
         path = export_artifact(args.model, args.dest, name=args.name, version=args.version)
         print(path)
+        return 0
+    if args.cmd == "repack":
+        from tfservingcache_tpu.models.registry import load_artifact, save_artifact
+
+        model, params = load_artifact(args.src)
+        print(save_artifact(args.dest, model, params))
         return 0
     return 2
 
