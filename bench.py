@@ -42,6 +42,11 @@ TARGET_S = 2.0
 _print_lock = threading.Lock()
 _printed = False
 
+# run() publishes each section here as it completes; the watchdog emits
+# these PARTIAL results (with an honest marker) instead of throwing away a
+# nearly-finished run when the budget expires
+PARTIAL: dict = {}
+
 
 def emit(payload: dict) -> None:
     """Print THE one JSON line (first caller wins; watchdog may race us)."""
@@ -541,7 +546,7 @@ def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dic
 
 
 def run(args) -> dict:
-    detail: dict = {}
+    detail = PARTIAL  # sections land here live so the watchdog can salvage
     platform, diag = probe_backend(args.init_timeout_s)
     detail["platform"] = platform
     detail["backend_diag"] = diag
@@ -671,15 +676,39 @@ def main() -> int:
 
     def watchdog() -> None:
         time.sleep(args.budget_s)
-        emit(
-            {
-                "metric": "cold_miss_load_to_first_predict_p50 (TIMEOUT)",
-                "value": None,
-                "unit": "s",
-                "vs_baseline": 0.0,
-                "detail": {"error": f"bench exceeded {args.budget_s}s budget"},
-            }
-        )
+        # salvage whatever sections completed: a budget overrun must not
+        # discard real cold-p50 measurements that already happened
+        detail = dict(PARTIAL)
+        detail["truncated"] = f"bench exceeded {args.budget_s}s budget"
+        p50s = {
+            fam: detail[fam]["cold_p50_s"]
+            for fam in ("mnist_cnn", "transformer_lm")
+            if isinstance(detail.get(fam), dict) and "cold_p50_s" in detail[fam]
+        }
+        if p50s:
+            worst = max(p50s, key=p50s.get)
+            emit(
+                {
+                    "metric": (
+                        f"cold_miss_load_to_first_predict_p50 (worst family: "
+                        f"{worst}; PARTIAL — budget hit)"
+                    ),
+                    "value": round(p50s[worst], 4),
+                    "unit": "s",
+                    "vs_baseline": round(args.target_s / p50s[worst], 3),
+                    "detail": detail,
+                }
+            )
+        else:
+            emit(
+                {
+                    "metric": "cold_miss_load_to_first_predict_p50 (TIMEOUT)",
+                    "value": None,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "detail": detail,
+                }
+            )
         os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
