@@ -1,0 +1,144 @@
+"""Unit coverage for the cross-host group plumbing (parallel/multihost.py):
+the work-envelope codec, the follower work handler's dispatch + error
+surfacing, and the leader's broadcast error propagation — pieces the
+2-process integration test (test_multihost.py) exercises but can't isolate."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.parallel.multihost import (
+    GroupWorkHandler,
+    GroupWorkServer,
+    MultiHostGroupRuntime,
+    decode_work,
+    encode_work,
+)
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.types import ModelId
+
+
+def test_work_envelope_roundtrip():
+    meta = {"op": "predict", "model": "m", "version": 3, "group": 1,
+            "output_filter": ["logits"]}
+    arrays = {
+        "input_ids": np.arange(6, dtype=np.int32).reshape(2, 3),
+        "prompt_lengths": np.array([3, 2], np.int32),
+    }
+    body = encode_work(meta, arrays)
+    meta2, arrays2 = decode_work(body)
+    assert meta2 == meta
+    np.testing.assert_array_equal(arrays2["input_ids"], arrays["input_ids"])
+    np.testing.assert_array_equal(arrays2["prompt_lengths"], arrays["prompt_lengths"])
+    # empty-array envelope
+    m3, a3 = decode_work(encode_work({"op": "ensure", "group": 0}))
+    assert m3["op"] == "ensure" and a3 == {}
+
+
+class _RecordingManager:
+    def __init__(self):
+        self.calls = []
+
+    def ensure_servable(self, mid):
+        self.calls.append(("ensure", mid))
+
+    def prefetch(self, mid):
+        self.calls.append(("prefetch", mid))
+
+
+class _RecordingRuntime:
+    def __init__(self):
+        self.calls = []
+
+    def predict(self, mid, inputs, output_filter=None):
+        self.calls.append(("predict", mid, sorted(inputs), output_filter))
+        return {}
+
+    def unload(self, mid):
+        self.calls.append(("unload", mid))
+
+
+async def _post(port, meta, arrays=None):
+    """POST a work envelope to a running GroupWorkServer."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            f"http://127.0.0.1:{port}/tpusc/groupwork",
+            data=encode_work(meta, arrays),
+        ) as resp:
+            return resp.status, await resp.json()
+
+
+async def test_handler_dispatch_and_errors():
+    handler = GroupWorkHandler()
+    mgr, rt = _RecordingManager(), _RecordingRuntime()
+    handler.register(2, mgr, rt)
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    try:
+        status, out = await _post(
+            port,
+            {"op": "predict", "model": "m", "version": 1, "group": 2,
+             "output_filter": None},
+            {"x": np.ones((1, 2), np.float32)},
+        )
+        assert status == 200 and out["ok"]
+        assert mgr.calls == [("ensure", ModelId("m", 1))]
+        assert rt.calls[0][:2] == ("predict", ModelId("m", 1))
+
+        status, out = await _post(
+            port, {"op": "prefetch", "model": "m", "version": 1, "group": 2}
+        )
+        assert status == 200 and ("prefetch", ModelId("m", 1)) in mgr.calls
+
+        status, out = await _post(
+            port, {"op": "unload", "model": "m", "version": 1, "group": 2}
+        )
+        assert status == 200 and ("unload", ModelId("m", 1)) in rt.calls
+
+        # unknown op -> 500 with the cause in the body
+        status, out = await _post(
+            port, {"op": "explode", "model": "m", "version": 1, "group": 2}
+        )
+        assert status == 500 and not out["ok"] and "explode" in out["error"]
+        # unknown group -> 500, not a crash
+        status, out = await _post(
+            port, {"op": "ensure", "model": "m", "version": 1, "group": 9}
+        )
+        assert status == 500 and "9" in out["error"]
+    finally:
+        await srv.close()
+
+
+async def test_leader_broadcast_surfaces_follower_error_detail():
+    """The leader's join must carry the follower's real exception text, not
+    just 'HTTP Error 500' (a prefetch IO failure must be diagnosable)."""
+
+    class _FailingManager(_RecordingManager):
+        def prefetch(self, mid):
+            raise FileNotFoundError(f"artifact store gone for {mid}")
+
+    handler = GroupWorkHandler()
+    handler.register(0, _FailingManager(), _RecordingRuntime())
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    try:
+        leader = MultiHostGroupRuntime(
+            ServingConfig(platform="cpu"),
+            followers=[f"127.0.0.1:{port}"],
+            group_index=0,
+        )
+        try:
+            futures = leader._broadcast(
+                {"op": "prefetch", "model": "m", "version": 1}
+            )
+            with pytest.raises(RuntimeError, match="artifact store gone"):
+                await asyncio.get_running_loop().run_in_executor(
+                    None, leader._join, futures
+                )
+        finally:
+            leader.close()
+    finally:
+        await srv.close()
