@@ -225,3 +225,50 @@ async def test_mnist_classify_rest_and_grpc(tmp_path):
                 data = await resp.json()
         row = data["predictions"][0]
         assert len(row["logits"]) == 10 and isinstance(row["classes"], int)
+
+
+async def test_predict_retries_once_on_eviction_race(tmp_path):
+    """An LRU eviction landing between ensure_servable and predict must be
+    absorbed by one reload+retry, not surfaced to the client — under
+    1000-tenant churn that interleaving is ordinary traffic."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.models.registry import export_artifact
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+    from tfservingcache_tpu.types import ModelId
+
+    store = tmp_path / "store"
+    export_artifact("half_plus_two", str(store), name="m", version=1)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu"))
+    mgr = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        rt,
+    )
+    backend = LocalServingBackend(mgr)
+    try:
+        mid = ModelId("m", 1)
+        mgr.ensure_servable(mid)
+        # simulate the race: evict exactly once, right as predict dispatches
+        real_predict = rt.predict
+        evicted = {"done": False}
+
+        def racing_predict(model_id, inputs, output_filter=None):
+            if not evicted["done"]:
+                evicted["done"] = True
+                rt.unload(model_id)  # the eviction wins the race
+            return real_predict(model_id, inputs, output_filter)
+
+        rt.predict = racing_predict
+        body = json.dumps({"instances": [1.0, 2.0]}).encode()
+        resp = await backend.handle_rest("POST", "m", 1, "predict", body)
+        assert resp.status == 200, resp.body
+        assert json.loads(resp.body)["predictions"] == [2.5, 3.0]
+        assert evicted["done"]
+    finally:
+        rt.predict = real_predict
+        backend.close()
+        mgr.close()

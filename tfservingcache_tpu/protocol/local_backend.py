@@ -29,7 +29,11 @@ from tfservingcache_tpu.protocol import codec
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
 from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
-from tfservingcache_tpu.runtime.base import LoadTimeoutError, RuntimeError_
+from tfservingcache_tpu.runtime.base import (
+    LoadTimeoutError,
+    ModelNotLoadedError,
+    RuntimeError_,
+)
 from tfservingcache_tpu.types import ModelId, ModelState
 from tfservingcache_tpu.utils.logging import get_logger
 
@@ -114,7 +118,14 @@ class LocalServingBackend(ServingBackend):
     ) -> dict[str, np.ndarray]:
         try:
             self.manager.ensure_servable(model_id)
-            return self._predictor.predict(model_id, inputs, output_filter)
+            try:
+                return self._predictor.predict(model_id, inputs, output_filter)
+            except ModelNotLoadedError:
+                # LRU eviction raced this request between ensure and predict
+                # (1000-tenant churn makes this ordinary, not exceptional):
+                # reload once and retry before surfacing anything
+                self.manager.ensure_servable(model_id)
+                return self._predictor.predict(model_id, inputs, output_filter)
         except ModelNotFoundError as e:
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
         except LoadTimeoutError as e:
@@ -197,7 +208,11 @@ class LocalServingBackend(ServingBackend):
         # outputs, which a family's serving default (LMs ship only
         # last_token_logits) would otherwise drop
         wanted = [n for n in ("scores", "logits", "labels") if n in out_spec]
-        outputs = self._predictor.predict(model_id, arrays, wanted or None)
+        try:
+            outputs = self._predictor.predict(model_id, arrays, wanted or None)
+        except ModelNotLoadedError:  # eviction raced; reload once
+            self._ensure_sync(model_id)
+            outputs = self._predictor.predict(model_id, arrays, wanted or None)
         result = sv.ClassificationResult()
         # scores: prefer explicit "scores", else softmax over "logits"
         scores = outputs.get("scores")
@@ -240,7 +255,11 @@ class LocalServingBackend(ServingBackend):
         # pick the regression output from the SIGNATURE and request it
         # explicitly — an LM's serving default would omit "logits"
         name = "outputs" if "outputs" in out_spec else next(iter(out_spec))
-        outputs = self._predictor.predict(model_id, arrays, [name])
+        try:
+            outputs = self._predictor.predict(model_id, arrays, [name])
+        except ModelNotLoadedError:  # eviction raced; reload once
+            self._ensure_sync(model_id)
+            outputs = self._predictor.predict(model_id, arrays, [name])
         vals = np.asarray(outputs[name], dtype=np.float64).reshape(-1)
         result = sv.RegressionResult()
         for v in vals:
@@ -432,7 +451,7 @@ class LocalServingBackend(ServingBackend):
                 grpc.StatusCode.INVALID_ARGUMENT, 400,
             )
 
-        def run() -> tuple[dict[str, np.ndarray], bool]:
+        def attempt() -> tuple[dict[str, np.ndarray], bool]:
             self._ensure_sync(model_id)
             in_spec, _, _ = self.manager.runtime.signature(model_id)
             dtypes = {k: s.np_dtype() for k, s in in_spec.items()}
@@ -446,6 +465,14 @@ class LocalServingBackend(ServingBackend):
                 raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
             row = "instances" in payload
             return self._predictor.predict(model_id, arrays, out_filter or None), row
+
+        def run() -> tuple[dict[str, np.ndarray], bool]:
+            try:
+                return attempt()
+            except ModelNotLoadedError:
+                # LRU eviction raced between ensure and predict — ordinary
+                # under tenant churn; reload once and retry
+                return attempt()
 
         outputs, row = await self._run(lambda: run())
 
@@ -499,11 +526,19 @@ class LocalServingBackend(ServingBackend):
                 )
                 arr = np.asarray(ids, np.int32)
                 if gen is not None:
-                    return gen.generate(
-                        model_id, arr,
-                        seed=int(payload["seed"]) if "seed" in payload else None,
-                        **kwargs,
-                    )
+                    try:
+                        return gen.generate(
+                            model_id, arr,
+                            seed=int(payload["seed"]) if "seed" in payload else None,
+                            **kwargs,
+                        )
+                    except ModelNotLoadedError:  # eviction raced; reload once
+                        self._ensure_sync(model_id)
+                        return gen.generate(
+                            model_id, arr,
+                            seed=int(payload["seed"]) if "seed" in payload else None,
+                            **kwargs,
+                        )
                 return self.manager.runtime.generate(
                     model_id, arr,
                     seed=(
