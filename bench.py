@@ -638,16 +638,18 @@ def run(args) -> dict:
     )
     lm_manager.close()
 
+    # flash before the chip-sized section: the kernel rows are a headline
+    # deliverable and must land even if the big-model section eats the budget
+    try:
+        detail["flash_kernel"] = bench_flash_kernel()
+    except Exception as e:  # noqa: BLE001 - kernel trouble must not sink the bench
+        detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
+
     if on_tpu:
         try:
             detail["chip_lm"] = bench_chip_model(tmp, device_kind)
         except Exception as e:  # noqa: BLE001
             detail["chip_lm"] = {"error": f"{type(e).__name__}: {e}"}
-
-    try:
-        detail["flash_kernel"] = bench_flash_kernel()
-    except Exception as e:  # noqa: BLE001 - kernel trouble must not sink the bench
-        detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
 
     try:
         detail["tenant_soak"] = bench_tenant_soak(tmp)
