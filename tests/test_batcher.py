@@ -57,6 +57,42 @@ def test_concurrent_requests_coalesce_into_fewer_device_calls():
     assert len(rt.predicts) < 8
 
 
+@pytest.mark.parametrize("max_inflight,min_peak,max_peak", [(4, 2, 4), (1, 1, 1)])
+def test_inflight_batches_pipeline_up_to_limit(max_inflight, min_peak, max_peak):
+    # one mutex per key (round-2 design) allowed a single in-flight batch,
+    # losing to the unbatched path on any transport whose round-trip
+    # dominates device time; the gate is now a counted semaphore
+    rt = FakeRuntime()
+    mid = load(rt)
+    active, peak = [0], [0]
+    lk = threading.Lock()
+    orig = rt.predict
+
+    def slow(*a, **kw):
+        with lk:
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+        time.sleep(0.05)
+        try:
+            return orig(*a, **kw)
+        finally:
+            with lk:
+                active[0] -= 1
+
+    rt.predict = slow
+    b = MicroBatcher(rt, max_batch=2, max_inflight=max_inflight)
+
+    def one(i):
+        x = np.array([float(i)], np.float32)
+        return float(b.predict(mid, {"x": x})["y"][0])
+
+    with ThreadPoolExecutor(max_workers=12) as pool:
+        results = list(pool.map(one, range(12)))
+
+    assert results == [float(i) for i in range(12)]
+    assert min_peak <= peak[0] <= max_peak
+
+
 def test_scatter_respects_row_counts_and_order():
     rt = make_runtime(delay_s=0.05)
     mid = load(rt, version=3)
@@ -197,7 +233,9 @@ def test_scatter_shape_mismatch_fails_batch_instead_of_leaking():
         return {"y": np.zeros((1,), np.float32)}  # always 1 row, whatever came in
 
     rt.predict = liar
-    b = MicroBatcher(rt, max_batch=64)
+    # max_inflight=1: accumulation-semantics test needs followers to coalesce
+    # behind the one busy slot
+    b = MicroBatcher(rt, max_batch=64, max_inflight=1)
 
     def one(i):
         return b.predict(mid, {"x": np.array([float(i), float(i)], np.float32)})
@@ -229,7 +267,7 @@ def test_arrivals_during_inflight_call_form_one_batch():
         return orig(m, inputs, f)
 
     rt.predict = record
-    b = MicroBatcher(rt, max_batch=64)
+    b = MicroBatcher(rt, max_batch=64, max_inflight=1)
 
     def one(i):
         return float(b.predict(mid, {"x": np.array([float(i)], np.float32)})["y"][0])

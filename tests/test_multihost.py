@@ -35,6 +35,9 @@ def test_two_process_group_serves_with_parity(tmp_path):
     # shared object storage), each keeps its own disk cache
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # children run as `python <script>` so sys.path[0] is tests/, not the
+    # repo root — the package import needs an explicit PYTHONPATH
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     subprocess.run(
         [
             sys.executable, "-c",
@@ -52,6 +55,7 @@ def test_two_process_group_serves_with_parity(tmp_path):
     args = [str(coord), str(w0), str(w1), str(tmp_path / "store"), str(tmp_path)]
     child_env = dict(os.environ)
     child_env.pop("XLA_FLAGS", None)
+    child_env["PYTHONPATH"] = REPO + os.pathsep + child_env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             [sys.executable, CHILD, str(pid), *args],

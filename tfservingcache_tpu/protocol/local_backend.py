@@ -65,6 +65,7 @@ class LocalServingBackend(ServingBackend):
         max_workers: int = 16,
         batch_window_ms: float = 0.0,
         batch_max_size: int = 64,
+        batch_max_inflight: int = 4,
     ) -> None:
         self.manager = manager
         # JAX dispatch is effectively serialized per device; a few workers
@@ -80,7 +81,8 @@ class LocalServingBackend(ServingBackend):
             )
 
             self._predictor = MicroBatcher(
-                manager.runtime, max_batch=batch_max_size, metrics=manager.metrics
+                manager.runtime, max_batch=batch_max_size,
+                metrics=manager.metrics, max_inflight=batch_max_inflight,
             )
             # concurrent :generate requests with matching buckets + sampling
             # params coalesce into one prefill+decode program

@@ -55,27 +55,59 @@ log = get_logger("runtime.batcher")
 from tfservingcache_tpu.runtime.model_runtime import next_bucket as _next_bucket
 
 
+class _Gate:
+    """A counted gate admitting up to ``limit`` concurrent holders.
+
+    One mutex per key (round-2 design) serialized ALL device calls for a
+    model: with the device/transport busy for RTT seconds, at most one batch
+    was ever in flight, while the unbatched path pipelines ``clients``
+    independent calls through the transport — the batcher *lost* throughput
+    on any link whose round-trip dominates device time (the r2 31% and the
+    r3 preview's 3x REST regression). A bounded semaphore keeps the
+    accumulate-while-busy behavior (leaders still block once ``limit``
+    batches are in flight, and arrivals join the blocked leader's batch)
+    while letting ``limit`` batches overlap host codec + transfer + compute."""
+
+    def __init__(self, limit: int) -> None:
+        self._sem = threading.BoundedSemaphore(limit)
+        self._count = threading.Lock()
+        self.in_use = 0
+
+    def __enter__(self) -> "_Gate":
+        self._sem.acquire()
+        with self._count:
+            self.in_use += 1
+        return self
+
+    def __exit__(self, *exc) -> None:
+        with self._count:
+            self.in_use -= 1
+        self._sem.release()
+
+
 class _GateMap:
     """Per-key device gates with bounded growth (shared by MicroBatcher and
-    GenerateCoalescer): serialize batches so arrivals during an in-flight
-    call accumulate into the next batch. Pruning keeps only locked gates;
-    losing an idle gate only costs a coalescing opportunity, never
+    GenerateCoalescer): bound how many batches per key are in flight so
+    arrivals during a saturated device accumulate into the next batch.
+    Pruning keeps only in-use gates; losing an idle gate only costs a
+    coalescing opportunity (or briefly exceeds the in-flight bound), never
     correctness."""
 
-    def __init__(self, max_entries: int = 4096) -> None:
+    def __init__(self, max_entries: int = 4096, limit: int = 4) -> None:
         self._lock = threading.Lock()
-        self._gates: dict[tuple, threading.Lock] = {}
+        self._gates: dict[tuple, _Gate] = {}
         self._max = max_entries
+        self._limit = max(1, limit)
 
-    def get(self, key: tuple) -> threading.Lock:
+    def get(self, key: tuple) -> _Gate:
         with self._lock:
             gate = self._gates.get(key)
             if gate is None:
                 if len(self._gates) > self._max:
                     self._gates = {
-                        k: g for k, g in self._gates.items() if g.locked()
+                        k: g for k, g in self._gates.items() if g.in_use
                     }
-                gate = self._gates.setdefault(key, threading.Lock())
+                gate = self._gates.setdefault(key, _Gate(self._limit))
             return gate
 
 
@@ -102,6 +134,7 @@ class MicroBatcher:
         max_batch: int = 64,
         wait_timeout_s: float = 600.0,
         metrics=None,
+        max_inflight: int = 4,
     ) -> None:
         self.runtime = runtime
         self.max_batch = max_batch
@@ -110,7 +143,7 @@ class MicroBatcher:
         self.metrics = metrics
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Pending] = {}
-        self._gates = _GateMap()
+        self._gates = _GateMap(limit=max_inflight)
         # signature() results are static per loaded model — cache the derived
         # axis maps so the hot path doesn't rebuild spec dicts per request
         self._axes_cache: dict[ModelId, dict[str, int] | None] = {}
@@ -180,7 +213,7 @@ class MicroBatcher:
             sig.append((name, str(arr.dtype), rest))
         return (model_id, tuple(sig), tuple(output_filter or ()))
 
-    def _gate(self, key: tuple) -> threading.Lock:
+    def _gate(self, key: tuple) -> _Gate:
         return self._gates.get(key)
 
     # -- core ---------------------------------------------------------------
@@ -344,6 +377,7 @@ class GenerateCoalescer:
         max_batch: int = 32,
         wait_timeout_s: float = 600.0,
         metrics=None,
+        max_inflight: int = 2,
     ) -> None:
         self.runtime = runtime
         self.max_batch = max_batch
@@ -351,11 +385,13 @@ class GenerateCoalescer:
         self.metrics = metrics
         self._lock = threading.Lock()
         self._pending: dict[tuple, _GenPending] = {}
-        self._gates = _GateMap()
+        # generate programs run for seconds: 2 in flight overlaps host prep
+        # with device decode without piling long jobs behind each other
+        self._gates = _GateMap(limit=max_inflight)
         self.batches = 0
         self.batched_requests = 0
 
-    def _gate(self, key: tuple) -> threading.Lock:
+    def _gate(self, key: tuple) -> _Gate:
         return self._gates.get(key)
 
     def generate(

@@ -208,16 +208,21 @@ class TPUModelRuntime(BaseRuntime):
 
         try:
             self._set_state(mid, ModelState.LOADING)
-            model_def, host_params = load_artifact(model.path)
+            with TRACER.span("artifact_read"):
+                model_def, host_params = load_artifact(model.path)
             if self.mesh is not None and model_def.partition_rules:
                 # multi-chip model: params sharded over the chip group per the
                 # family's partition rules; XLA partitions the computation and
                 # inserts ICI collectives from the committed shardings
                 from tfservingcache_tpu.parallel.sharding import shard_params
 
-                params = shard_params(host_params, model_def.partition_rules, self.mesh)
+                with TRACER.span("device_transfer"):
+                    params = shard_params(
+                        host_params, model_def.partition_rules, self.mesh
+                    )
             else:
-                params = packed_device_put(host_params, self._devices[0])
+                with TRACER.span("device_transfer"):
+                    params = packed_device_put(host_params, self._devices[0])
             key = model_def.cache_key
             # mesh-aware families (ring/context-parallel attention) build
             # their apply against THIS group's mesh; per-runtime jit cache
@@ -260,7 +265,10 @@ class TPUModelRuntime(BaseRuntime):
                     with TRACER.span("compile_warmup", family=model_def.family):
                         self._warmup(loaded)  # compile happens here, outside the lock
                 else:
-                    jax.block_until_ready(params)
+                    # transfer is async: this sync is where the host<->HBM
+                    # link's sustained rate actually shows up for siblings
+                    with TRACER.span("transfer_sync"):
+                        jax.block_until_ready(params)
                 with self._jit_lock:
                     # increment + insert atomically w.r.t. evictions: an
                     # eviction of a same-family sibling between put and

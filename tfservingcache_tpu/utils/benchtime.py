@@ -21,6 +21,7 @@ def chained_device_time(
     fn: Callable[..., Any],
     args: Sequence[Any],
     iters: int = 16,
+    repeats: int = 3,
 ) -> float:
     """Seconds per call of ``fn(*args)`` measured on device.
 
@@ -28,6 +29,13 @@ def chained_device_time(
     feeds the inter-iteration dependency). ``args[0]`` must be a float array:
     iteration i+1 perturbs it by ``1e-6 * out[0]`` so no two iterations are
     identical and the chain cannot be hoisted, cached, or reordered.
+
+    Every *timed* call also gets a freshly perturbed ``args[0]`` — re-running
+    an (executable, inputs) pair the warmup already executed can be answered
+    from the transport's result cache without touching the device, which
+    flattens both sides of a comparison to the noise floor. The per-iter
+    estimate is the median over ``repeats`` independent (1-iter, n-iter)
+    pairs.
     """
     import jax
     import jax.numpy as jnp
@@ -44,11 +52,31 @@ def chained_device_time(
         return acc
 
     args = tuple(args)
+
+    salt = [0]
+    # step must survive rounding in args[0]'s dtype AT ITS MAGNITUDE: eps is
+    # the spacing at 1.0, so an absolute step washes out for inputs of
+    # magnitude >~ 8 (and bf16 eps ~8e-3 already needs it at magnitude 1) —
+    # scale by max|args[0]| so at least the largest elements change
+    scale = max(1.0, float(jnp.max(jnp.abs(args[0].astype(jnp.float32)))))
+    step = 8 * float(jnp.finfo(args[0].dtype).eps) * scale
+
+    def fresh() -> tuple:
+        salt[0] += 1
+        a0 = args[0] + jnp.asarray(salt[0] * step, args[0].dtype)
+        jax.block_until_ready(a0)
+        return (a0,) + args[1:]
+
     float(loop(args, 1))        # compile the 1-iter program
     float(loop(args, iters))    # compile the n-iter program
-    t0 = time.perf_counter()
-    float(loop(args, 1))
-    t1 = time.perf_counter()
-    float(loop(args, iters))
-    t2 = time.perf_counter()
-    return max((t2 - t1) - (t1 - t0), 1e-9) / (iters - 1)
+    estimates = []
+    for _ in range(repeats):
+        a_short, a_long = fresh(), fresh()
+        t0 = time.perf_counter()
+        float(loop(a_short, 1))
+        t1 = time.perf_counter()
+        float(loop(a_long, iters))
+        t2 = time.perf_counter()
+        estimates.append(max((t2 - t1) - (t1 - t0), 1e-9) / (iters - 1))
+    estimates.sort()
+    return estimates[len(estimates) // 2]
