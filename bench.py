@@ -28,6 +28,7 @@ guarantees exactly one JSON line on stdout no matter what hangs.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import statistics
@@ -186,23 +187,18 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
     return manager, runtime
 
 
+@contextlib.contextmanager
 def _section(name: str):
     """Record + print each section's wall time so a budget overrun is
     attributable (the r3 preview burned its whole budget with no trace of
     where)."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def cm():
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            PARTIAL.setdefault("section_s", {})[name] = round(dt, 1)
-            print(f"[bench] {name}: {dt:.1f}s", file=sys.stderr, flush=True)
-
-    return cm()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        PARTIAL.setdefault("section_s", {})[name] = round(dt, 1)
+        print(f"[bench] {name}: {dt:.1f}s", file=sys.stderr, flush=True)
 
 
 def _warm_buckets(runtime, mid, inputs, max_batch: int = 64) -> None:

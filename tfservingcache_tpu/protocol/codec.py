@@ -190,6 +190,21 @@ def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
             return np.stack(rows)
         except ValueError as e:
             raise CodecError(f"binary instance rows disagree in shape: {e}") from e
+    if isinstance(value, (list, int, float, bool)):
+        # fast path: a dense numeric tensor cannot contain {"b64"} or string
+        # leaves (either would force dtype=object/str below), so the C-level
+        # asarray replaces the per-element Python walk — which profiled at
+        # ~16 ms per 6k-element request, the REST hot path's dominant cost
+        try:
+            arr = np.asarray(value)
+        except (ValueError, TypeError):
+            arr = np.empty(0, object)  # ragged/mixed: take the slow path
+        if arr.dtype.kind in "fiub":
+            if dtype is not None:
+                return arr.astype(dtype)
+            if arr.dtype == np.float64:
+                return arr.astype(np.float32)  # JSON numbers -> f32 for the MXU
+            return arr
     value = _json_to_value(value)
 
     def has_bytes(v: Any) -> bool:

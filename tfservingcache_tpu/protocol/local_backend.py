@@ -85,10 +85,13 @@ class LocalServingBackend(ServingBackend):
                 metrics=manager.metrics, max_inflight=batch_max_inflight,
             )
             # concurrent :generate requests with matching buckets + sampling
-            # params coalesce into one prefill+decode program
+            # params coalesce into one prefill+decode program; generate runs
+            # for seconds, so its in-flight bound caps at 2 — but it still
+            # honors a stricter batch_max_inflight (1 = strict serialization)
             self._generator = GenerateCoalescer(
                 manager.runtime, max_batch=min(batch_max_size, 32),
                 metrics=manager.metrics,
+                max_inflight=min(2, batch_max_inflight),
             )
         else:
             self._predictor = manager.runtime
