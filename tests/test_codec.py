@@ -1,3 +1,5 @@
+import json
+
 import ml_dtypes
 import numpy as np
 import pytest
@@ -195,3 +197,92 @@ def test_binary_tensor_bf16_roundtrip_and_row_format():
         decode_predict_json(
             {"inputs": {"x": {"b64": "AAAA", "dtype": "V4", "shape": [1]}}}, {}
         )
+
+
+# -- native-spliced response bytes (encode_predict_json_bytes) ---------------
+
+@pytest.mark.parametrize("row_format", [False, True])
+@pytest.mark.parametrize(
+    "outputs",
+    [
+        {"y": np.arange(12, dtype=np.float32).reshape(3, 4) / 7},
+        {"y": np.array([[1, -2], [3, 4]], np.int64)},
+        {"y": np.array([True, False])},
+        {"y": np.float32(2.5)},  # 0-d
+        {"y": np.zeros((2, 0, 3), np.float32)},  # empty dim
+        {"a": np.ones((2, 2), np.float32), "b": np.arange(2, dtype=np.int32)},
+        {"y": np.array([[0.1, np.nan], [np.inf, -np.inf]], np.float32)},
+        {"y": np.array([1.5, 2.25], ml_dtypes.bfloat16)},
+    ],
+)
+def test_encode_bytes_parse_equals_python_path(outputs, row_format):
+    """The native-spliced body must parse to the same values (after casting
+    back to the source dtype) as json.dumps(encode_predict_json(...))."""
+    import json
+
+    from tfservingcache_tpu.protocol.codec import encode_predict_json_bytes
+
+    got = json.loads(encode_predict_json_bytes(outputs, row_format=row_format))
+    want = json.loads(
+        json.dumps(encode_predict_json(outputs, row_format=row_format))
+    )
+    np.testing.assert_equal(_tree_cast_f32(got), _tree_cast_f32(want))
+
+
+def _tree_cast_f32(v):
+    """Cast every number to float32 so shortest-f32 vs double reprs of the
+    same float32 value compare equal; NaN-safe via numpy assert_equal."""
+    if isinstance(v, dict):
+        return {k: _tree_cast_f32(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_tree_cast_f32(x) for x in v]
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return np.float32(v)
+    return v
+
+
+def test_encode_bytes_string_outputs_fall_back():
+    import json
+
+    from tfservingcache_tpu.protocol.codec import encode_predict_json_bytes
+
+    out = {"s": np.array([b"ab", b"cd"], object)}
+    got = json.loads(encode_predict_json_bytes(out, row_format=False))
+    assert got == {"outputs": [{"b64": "YWI="}, {"b64": "Y2Q="}]}
+
+
+def test_encode_bytes_without_native_matches(monkeypatch):
+    import json
+
+    from tfservingcache_tpu import native
+    from tfservingcache_tpu.protocol.codec import encode_predict_json_bytes
+
+    monkeypatch.setattr(native, "json_encode_array", lambda a: None)
+    out = {"y": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    got = json.loads(encode_predict_json_bytes(out, row_format=False))
+    assert got == {"outputs": [[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]]}
+
+
+def test_encode_bytes_non_native_endian_falls_back():
+    import json
+
+    from tfservingcache_tpu.protocol.codec import encode_predict_json_bytes
+
+    out = {"y": np.array([1.5, 2.5], ">f4")}
+    got = json.loads(encode_predict_json_bytes(out, row_format=False))
+    assert got == {"outputs": [1.5, 2.5]}  # NOT little-endian garbage
+
+
+def test_native_encoder_retries_when_first_buffer_too_small():
+    from tfservingcache_tpu import native
+
+    if not native.native_available():
+        pytest.skip("native tier unavailable")
+    # tiny element count but max-width values: the n*14 first-try guess is
+    # too small, forcing the C side's -(needed) retry path
+    a = np.array([-2.2250738585072014e-308] * 4, np.float64)
+    b = native.json_encode_array(a)
+    assert b is not None
+    np.testing.assert_array_equal(np.array(json.loads(b)), a)

@@ -83,6 +83,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.tpusc_lru_keys.restype = ctypes.c_int
     lib.tpusc_lru_clear.argtypes = [ctypes.c_void_p]
+    lib.tpusc_json_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_longlong,
+    ]
+    lib.tpusc_json_encode.restype = ctypes.c_longlong
     return lib
 
 
@@ -114,7 +119,9 @@ def load() -> ctypes.CDLL | None:
                 return None
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so predating a newer symbol
+            # (no toolchain to rebuild) must not take down the whole tier
             return None
         return _lib
 
@@ -364,3 +371,51 @@ def make_lru_cache(
     from tfservingcache_tpu.cache.lru import LRUCache
 
     return LRUCache(capacity_bytes, on_evict, max_items)
+
+
+# -- JSON tensor encoder ------------------------------------------------------
+
+# numpy dtype name -> tpusc_json_encode kind (src/tpusc_native.cc)
+_JSON_KINDS = {
+    "float32": 1, "float64": 2, "int32": 3, "int64": 4, "bool": 5, "uint8": 6,
+}
+
+
+def json_encode_array(arr) -> bytes | None:
+    """JSON nested-list text for a numeric ndarray, written straight from the
+    buffer by the native encoder — ~10x json.dumps(arr.tolist()) on the REST
+    response hot path. Returns None when the library or dtype is unavailable
+    (caller falls back to the Python path). Float text is the shortest
+    round-trip repr for the SOURCE dtype; non-finite values use Python's
+    json tokens (NaN/Infinity/-Infinity)."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        return None
+    a = np.asarray(arr)
+    if not a.dtype.isnative:
+        return None  # C++ reads host byte order; '>f4' etc. take the Python path
+    if not a.flags["C_CONTIGUOUS"]:
+        # NOT ascontiguousarray unconditionally: it promotes 0-d to 1-d,
+        # which would wrap a scalar response in brackets
+        a = np.ascontiguousarray(a)
+    kind = _JSON_KINDS.get(a.dtype.name)
+    if kind is None:
+        return None
+    ndim = a.ndim
+    shape = (ctypes.c_int64 * max(ndim, 1))(*(a.shape or (0,)))
+    # first-try guess; the C side owns the real bound and returns -(needed)
+    # when this is short, so the width tables can't drift apart
+    cap = int(a.size) * 14 + 64
+    for _ in range(2):
+        buf = ctypes.create_string_buffer(cap)
+        wrote = lib.tpusc_json_encode(
+            a.ctypes.data_as(ctypes.c_void_p), kind, shape, ndim, buf, cap
+        )
+        if wrote >= 0:
+            return buf.raw[:wrote]
+        if wrote == -1:
+            return None
+        cap = -wrote
+    return None

@@ -21,6 +21,8 @@
 // shared side so concurrent request routing never serializes.
 
 #include <algorithm>
+#include <charconv>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <list>
@@ -438,6 +440,114 @@ void tpusc_lru_clear(void* l) {
   lru->order.clear();
   lru->index.clear();
   lru->total = 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// JSON tensor encoder
+//
+// The REST ":predict" response path serializes output tensors as JSON number
+// lists; CPython's json.dumps walks a Python list tree at ~1 M floats/s,
+// which caps an LM's (B, vocab) last-token response at <100 qps per host
+// core.  This encoder writes the nested-list JSON straight from the numpy
+// buffer with std::to_chars (shortest round-trip representation for the
+// SOURCE dtype, so float32 prints "0.1", not the 17-digit double repr of
+// the nearest double — parse-equal after the client's float32 cast, and
+// ~40% smaller).  Non-finite values print the tokens Python's json module
+// emits (NaN / Infinity / -Infinity) so existing clients see no change.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+template <typename T>
+inline char* write_num(char* p, T v) {
+  auto r = std::to_chars(p, p + 32, v);
+  return r.ptr;
+}
+
+template <typename T>
+inline char* write_float(char* p, T v) {
+  if (std::isfinite(v)) {
+    auto r = std::to_chars(p, p + 32, v);
+    return r.ptr;
+  }
+  const char* s = std::isnan(v) ? "NaN" : (v > 0 ? "Infinity" : "-Infinity");
+  size_t n = std::strlen(s);
+  std::memcpy(p, s, n);
+  return p + n;
+}
+
+inline char* write_bool(char* p, uint8_t v) {
+  const char* s = v ? "true" : "false";
+  size_t n = std::strlen(s);
+  std::memcpy(p, s, n);
+  return p + n;
+}
+
+template <typename T, typename Writer>
+char* enc_dim(const T*& d, const int64_t* shape, int ndim, int dim, char* p,
+              Writer w) {
+  if (dim == ndim) {
+    return w(p, *d++);
+  }
+  *p++ = '[';
+  for (int64_t i = 0; i < shape[dim]; ++i) {
+    if (i) *p++ = ',';
+    p = enc_dim(d, shape, ndim, dim + 1, p, w);
+  }
+  *p++ = ']';
+  return p;
+}
+
+template <typename T, typename Writer>
+long long enc_typed(const void* data, const int64_t* shape, int ndim,
+                    char* out, long long cap, int per_elem, Writer w) {
+  long long n = 1, brackets = 1;
+  for (int k = 0; k < ndim; ++k) {
+    n *= shape[k];
+    if (k + 1 < ndim) brackets += n;
+  }
+  if (ndim == 0) brackets = 0;
+  // worst case: every element + separator, every bracket pair, slack
+  long long bound = n * (per_elem + 1) + brackets * 2 + 16;
+  if (bound > cap) return -bound;  // caller retries with the returned size
+  const T* d = static_cast<const T*>(data);
+  char* p = enc_dim(d, shape, ndim, 0, out, w);
+  return p - out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// kind: 1=float32 2=float64 3=int32 4=int64 5=bool8 6=uint8
+// Returns bytes written; -1 = unsupported kind; any other negative value is
+// -(required capacity) — retry with that size.
+long long tpusc_json_encode(const void* data, int kind, const int64_t* shape,
+                            int ndim, char* out, long long cap) {
+  switch (kind) {
+    case 1:
+      return enc_typed<float>(data, shape, ndim, out, cap, 24,
+                              [](char* p, float v) { return write_float(p, v); });
+    case 2:
+      return enc_typed<double>(data, shape, ndim, out, cap, 26,
+                               [](char* p, double v) { return write_float(p, v); });
+    case 3:
+      return enc_typed<int32_t>(data, shape, ndim, out, cap, 12,
+                                [](char* p, int32_t v) { return write_num(p, v); });
+    case 4:
+      return enc_typed<int64_t>(data, shape, ndim, out, cap, 21,
+                                [](char* p, int64_t v) { return write_num(p, v); });
+    case 5:
+      return enc_typed<uint8_t>(data, shape, ndim, out, cap, 6,
+                                [](char* p, uint8_t v) { return write_bool(p, v); });
+    case 6:
+      return enc_typed<uint8_t>(data, shape, ndim, out, cap, 4,
+                                [](char* p, uint8_t v) { return write_num(p, v); });
+    default:
+      return -1;
+  }
 }
 
 }  // extern "C"
