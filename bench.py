@@ -343,6 +343,11 @@ async def _rest_warm_qps(manager, family: str, variants: list[dict],
         bodies = [
             {"inputs": {k: a.tolist() for k, a in v.items()}} for v in variants
         ]
+    # pre-serialize ONCE: the single-core harness shares the client and the
+    # server; re-encoding a 60 KB body per post would bill client work to
+    # the server's measured QPS
+    bodies = [json.dumps(b).encode() for b in bodies]
+    headers = {"Content-Type": "application/json"}
     url = f"http://127.0.0.1:{port}/v1/models/tenant0/versions/1:{verb}"
     counts = [0] * clients
     stop = 0.0  # set after the settle phase
@@ -350,7 +355,7 @@ async def _rest_warm_qps(manager, family: str, variants: list[dict],
     async def worker(i: int, session) -> None:
         j = i  # offset so clients don't march in lockstep
         while time.perf_counter() < stop:
-            async with session.post(url, json=bodies[j % len(bodies)]) as resp:
+            async with session.post(url, data=bodies[j % len(bodies)], headers=headers) as resp:
                 if resp.status != 200:
                     raise RuntimeError(f"{verb} failed: {await resp.text()}")
                 await resp.read()
@@ -360,12 +365,12 @@ async def _rest_warm_qps(manager, family: str, variants: list[dict],
     async with aiohttp.ClientSession() as session:
         # settle phase: concurrent warm-up so coalesced-batch bucket compiles
         # (8, 16, 32... rows) happen BEFORE the measured window
-        async with session.post(url, json=bodies[0]) as resp:
+        async with session.post(url, data=bodies[0], headers=headers) as resp:
             assert resp.status == 200, await resp.text()
 
         async def settle(i: int) -> None:
             for k in range(3):
-                async with session.post(url, json=bodies[(i + k) % len(bodies)]) as resp:
+                async with session.post(url, data=bodies[(i + k) % len(bodies)], headers=headers) as resp:
                     await resp.read()
 
         await asyncio.gather(*(settle(i) for i in range(clients)))
