@@ -286,3 +286,90 @@ def test_native_encoder_retries_when_first_buffer_too_small():
     b = native.json_encode_array(a)
     assert b is not None
     np.testing.assert_array_equal(np.array(json.loads(b)), a)
+
+
+# -- native request parser (loads_request) -----------------------------------
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        b'{"inputs": {"image": %s}}' % json.dumps(
+            [[0.5 * i + j for i in range(10)] for j in range(10)]
+        ).encode(),
+        b'{"instances": %s, "signature_name": "s"}' % json.dumps(
+            [[i % 7 for i in range(80)]]
+        ).encode(),
+        b'{"inputs": [1.5, 2.5], "output_filter": ["logits"]}',
+        b'{"a": {"b": [1, 2, 3]}, "c": "text", "d": null, "e": [true, false]}',
+        b'[NaN, Infinity, -Infinity]',
+        b'{"mixed": [[1, 2], "x"], "big": %s}' % json.dumps(
+            list(range(100))
+        ).encode(),
+    ],
+)
+def test_loads_request_parity_with_json_loads(body):
+    from tfservingcache_tpu.protocol.codec import loads_request
+
+    def norm(v):
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, dict):
+            return {k: norm(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [norm(x) for x in v]
+        return v
+
+    np.testing.assert_equal(norm(loads_request(body)), json.loads(body))
+
+
+@pytest.mark.parametrize(
+    "body", [b'{"a": [1,2', b'{"a" 1}', b"[01]", b'{"a": 1}trailing', b""]
+)
+def test_loads_request_malformed_raises_valueerror(body):
+    from tfservingcache_tpu.protocol.codec import loads_request
+
+    with pytest.raises(ValueError):
+        loads_request(body)
+    with pytest.raises(ValueError):
+        json.loads(body)  # parity: stdlib agrees these are malformed
+
+
+def test_decode_predict_json_accepts_extracted_arrays():
+    big = np.arange(128, dtype=np.int64).reshape(2, 64)
+    arrays, sig = decode_predict_json(
+        {"instances": big.astype(np.float64)}, {"x": np.dtype(np.float32)}
+    )
+    assert arrays["x"].dtype == np.float32 and arrays["x"].shape == (2, 64)
+    arrays, _ = decode_predict_json({"inputs": {"x": big}}, {"x": np.dtype(np.int32)})
+    assert arrays["x"].dtype == np.int32
+    with pytest.raises(CodecError):
+        decode_predict_json({"instances": np.empty((0,), np.float64)}, {})
+
+
+def test_loads_request_reviewer_repros():
+    """Cases that broke the first native-parser draft: per-level-count
+    collisions, depth bombs, >32-dim dense arrays — all must parse exactly
+    like json.loads (via decline/fallback where needed)."""
+    from tfservingcache_tpu.protocol.codec import loads_request
+
+    def norm(v):
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, dict):
+            return {k: norm(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [norm(x) for x in v]
+        return v
+
+    # mixed-depth siblings whose per-level counts collide
+    body = (b'{"x": [[1,2],[' +
+            json.dumps([list(range(32)), list(range(32))]).encode() +
+            b']], "y": 5}')
+    np.testing.assert_equal(norm(loads_request(body)), json.loads(body))
+    # depth bomb: valid JSON beyond the native depth cap -> fallback
+    bomb = b'{"a":' * 65 + b'1' + b'}' * 65
+    np.testing.assert_equal(norm(loads_request(bomb)), json.loads(bomb))
+    # 33-dim dense array of 64 ints: rank-capped -> decline, parity kept
+    deep = b'[' * 33 + b",".join(b"%d" % i for i in range(64)) + b']' * 33
+    body = b'{"t": ' + deep + b'}'
+    np.testing.assert_equal(norm(loads_request(body)), json.loads(body))

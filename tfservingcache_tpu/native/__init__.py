@@ -88,6 +88,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_char_p, ctypes.c_longlong,
     ]
     lib.tpusc_json_encode.restype = ctypes.c_longlong
+    lib.tpusc_json_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_char_p,
+    ]
+    lib.tpusc_json_parse.restype = ctypes.c_void_p
+    lib.tpusc_jp_ok.argtypes = [ctypes.c_void_p]
+    lib.tpusc_jp_ok.restype = ctypes.c_int
+    lib.tpusc_jp_declined.argtypes = [ctypes.c_void_p]
+    lib.tpusc_jp_declined.restype = ctypes.c_int
+    lib.tpusc_jp_error.argtypes = [ctypes.c_void_p]
+    lib.tpusc_jp_error.restype = ctypes.c_char_p
+    lib.tpusc_jp_skeleton.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.tpusc_jp_skeleton.restype = ctypes.c_void_p
+    lib.tpusc_jp_ntensors.argtypes = [ctypes.c_void_p]
+    lib.tpusc_jp_ntensors.restype = ctypes.c_int
+    lib.tpusc_jp_tensor_info.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.tpusc_jp_tensor_info.restype = ctypes.c_int
+    lib.tpusc_jp_tensor_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tpusc_jp_tensor_data.restype = ctypes.c_void_p
+    lib.tpusc_jp_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -419,3 +444,87 @@ def json_encode_array(arr) -> bytes | None:
             return None
         cap = -wrote
     return None
+
+
+# -- JSON request parser ------------------------------------------------------
+
+_PARSE_NONCE = None
+
+
+def json_parse_request(body: bytes):
+    """Parse a JSON request body with dense numeric subtrees extracted as
+    numpy arrays (int64 when every token is integral, else float64).
+
+    Returns the parsed structure, or None when the native tier is
+    unavailable or declines (caller falls back to ``json.loads``). Raises
+    ``ValueError`` for bodies the parser proves malformed — message parity
+    with json.loads is NOT guaranteed, so callers should re-raise through
+    their existing error mapping.
+
+    Extraction marks subtrees with a per-process nonce'd placeholder string,
+    so payload strings cannot collide with placeholders across processes;
+    a literal placeholder string inside the SAME request could only remap
+    that request's own tensors, never another request's."""
+    import secrets
+
+    import numpy as np
+
+    global _PARSE_NONCE
+    lib = load()
+    if lib is None:
+        return None
+    if _PARSE_NONCE is None:
+        _PARSE_NONCE = secrets.token_hex(8)
+    nonce = _PARSE_NONCE
+    h = lib.tpusc_json_parse(body, len(body), nonce.encode())
+    if not h:
+        return None
+    try:
+        if not lib.tpusc_jp_ok(h):
+            if lib.tpusc_jp_declined(h):
+                return None  # beyond this parser (e.g. depth), not malformed
+            raise ValueError(
+                (lib.tpusc_jp_error(h) or b"invalid JSON").decode()
+            )
+        slen = ctypes.c_longlong()
+        sptr = lib.tpusc_jp_skeleton(h, ctypes.byref(slen))
+        skeleton = ctypes.string_at(sptr, slen.value)
+        import json
+
+        tree = json.loads(skeleton)
+        nt = lib.tpusc_jp_ntensors(h)
+        if nt == 0:
+            return tree
+        arrays = []
+        for k in range(nt):
+            is_int = ctypes.c_int()
+            nelems = ctypes.c_longlong()
+            shape = (ctypes.c_int64 * 32)()
+            ndim = lib.tpusc_jp_tensor_info(
+                h, k, ctypes.byref(is_int), shape, 32, ctypes.byref(nelems)
+            )
+            dt = np.int64 if is_int.value else np.float64
+            data = lib.tpusc_jp_tensor_data(h, k)
+            flat = np.ctypeslib.as_array(
+                ctypes.cast(data, ctypes.POINTER(ctypes.c_int64 if is_int.value
+                                                 else ctypes.c_double)),
+                shape=(max(nelems.value, 0),),
+            )
+            arrays.append(
+                flat.astype(dt, copy=True).reshape(tuple(shape[:ndim]))
+            )
+        prefix = "\x07" + nonce + ":"
+
+        def swap(v):
+            if isinstance(v, dict):
+                return {k2: swap(x) for k2, x in v.items()}
+            if isinstance(v, list):
+                return [swap(x) for x in v]
+            if isinstance(v, str) and v.startswith(prefix):
+                idx = int(v[len(prefix):])
+                return arrays[idx]
+            return v
+
+        return swap(tree)
+    finally:
+        lib.tpusc_jp_free(h)

@@ -551,3 +551,432 @@ long long tpusc_json_encode(const void* data, int kind, const int64_t* shape,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// JSON request parser with dense-tensor extraction
+//
+// The decode side of the REST hot path: json.loads of a ":predict" body
+// builds one Python object per number (~1 M numbers/s).  This parser walks
+// the body once; every maximal dense numeric array of >= kTensorMinElems
+// elements becomes a flat typed buffer + shape, and the remaining skeleton
+// (envelope keys, small lists, strings) is re-emitted as JSON with each
+// extracted tensor replaced by a placeholder string the Python side swaps
+// for a numpy array.  Anything unusual — ragged shapes, mixed types, depth
+// bombs, out-of-range ints — declines extraction (span-copied verbatim) or
+// fails the whole parse, and the caller falls back to Python json.loads.
+//
+// Number/int semantics match Python's json: a token is integral iff it has
+// no '.', 'e', 'E'; NaN/Infinity/-Infinity are accepted as doubles.
+// ---------------------------------------------------------------------------
+
+namespace jsonp {
+
+constexpr int kMaxDepth = 64;
+constexpr int kTensorMaxDims = 32;  // = the ctypes bridge's shape buffer
+constexpr long long kTensorMinElems = 64;
+
+struct Tensor {
+  bool is_int = true;
+  std::vector<int64_t> shape;
+  std::vector<double> vals;
+  std::vector<int64_t> ivals;
+};
+
+struct Parser {
+  const char* s;
+  long long n;
+  long long i = 0;
+  bool ok = true;
+  bool declined = false;  // structurally fine for json.loads, beyond us
+  std::string err;
+  std::string out;          // skeleton JSON
+  std::string nonce;
+  std::vector<Tensor> tensors;
+
+  explicit Parser(const char* text, long long len, const char* nonce_)
+      : s(text), n(len), nonce(nonce_) {
+    out.reserve(256);
+  }
+
+  void fail(const std::string& m) {
+    if (ok) {
+      ok = false;
+      err = m + " at offset " + std::to_string(i);
+    }
+  }
+
+  void skip_ws() {
+    while (i < n && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r'))
+      i++;
+  }
+
+  bool lit(const char* w) {
+    long long len = static_cast<long long>(std::strlen(w));
+    if (i + len <= n && std::memcmp(s + i, w, len) == 0) {
+      i += len;
+      return true;
+    }
+    return false;
+  }
+
+  // scan a string token (assumes s[i] == '"'); returns false on error
+  bool scan_string() {
+    i++;  // opening quote
+    while (i < n) {
+      unsigned char c = s[i];
+      if (c == '"') {
+        i++;
+        return true;
+      }
+      if (c == '\\') {
+        i += 2;
+        if (i > n) break;
+        continue;
+      }
+      if (c < 0x20) {
+        fail("control character in string");
+        return false;
+      }
+      i++;
+    }
+    fail("unterminated string");
+    return false;
+  }
+
+  // scan a number token; *integral = no '.', 'e', 'E'
+  bool scan_number(bool* integral) {
+    long long start = i;
+    *integral = true;
+    if (i < n && s[i] == '-') i++;
+    if (i >= n || !(s[i] >= '0' && s[i] <= '9')) {
+      fail("bad number");
+      return false;
+    }
+    if (s[i] == '0') {
+      i++;  // JSON: a leading zero cannot be followed by more digits
+      if (i < n && s[i] >= '0' && s[i] <= '9') {
+        fail("leading zero");
+        return false;
+      }
+    } else {
+      while (i < n && s[i] >= '0' && s[i] <= '9') i++;
+    }
+    if (i < n && s[i] == '.') {
+      *integral = false;
+      i++;
+      if (i >= n || !(s[i] >= '0' && s[i] <= '9')) {
+        fail("bad number fraction");
+        return false;
+      }
+      while (i < n && s[i] >= '0' && s[i] <= '9') i++;
+    }
+    if (i < n && (s[i] == 'e' || s[i] == 'E')) {
+      *integral = false;
+      i++;
+      if (i < n && (s[i] == '+' || s[i] == '-')) i++;
+      if (i >= n || !(s[i] >= '0' && s[i] <= '9')) {
+        fail("bad number exponent");
+        return false;
+      }
+      while (i < n && s[i] >= '0' && s[i] <= '9') i++;
+    }
+    (void)start;
+    return true;
+  }
+
+  // Try to parse the array starting at s[i] (s[i]=='[') as a dense numeric
+  // nd-array into t.  On structural mismatch (non-number leaf, ragged),
+  // returns false with i restored — caller re-parses generically.  Hard
+  // syntax errors set ok=false.
+  bool try_tensor(Tensor* t) {
+    long long save = i;
+    t->shape.clear();
+    t->vals.clear();
+    t->ivals.clear();
+    t->is_int = true;
+    std::vector<int64_t> shape;      // discovered on the first spine
+    std::vector<int64_t> counts;     // current index per depth
+    if (!tensor_dim(t, &shape, 0)) {
+      i = save;
+      return false;
+    }
+    t->shape = shape;
+    long long total = 1;
+    for (int64_t d : shape) total *= d;
+    if (total != static_cast<long long>(t->vals.size())) {
+      i = save;  // decline cleanly: the generic path re-parses from the start
+      return false;
+    }
+    (void)counts;
+    return ok;
+  }
+
+  bool tensor_dim(Tensor* t, std::vector<int64_t>* shape, int depth) {
+    if (depth >= kTensorMaxDims) return false;  // rank-capped: decline, not fail
+    if (i >= n || s[i] != '[') return false;
+    i++;
+    skip_ws();
+    bool first_spine = static_cast<int>(shape->size()) <= depth;
+    if (first_spine) shape->push_back(0);
+    int64_t count = 0;
+    bool saw_leaf = false, saw_arr = false;
+    if (i < n && s[i] == ']') {
+      i++;
+      // empty dim: record 0; deeper shape unknown — only accept if this is
+      // the innermost level seen so far (shape stays [..., 0])
+      if (!first_spine && (*shape)[depth] != 0) return false;
+      (*shape)[depth] = 0;
+      return true;
+    }
+    while (i < n) {
+      skip_ws();
+      if (i < n && s[i] == '[') {
+        if (saw_leaf) return false;  // mixed leaf/array siblings: not dense
+        saw_arr = true;
+        if (!tensor_dim(t, shape, depth + 1)) return false;
+      } else {
+        if (saw_arr) return false;
+        saw_leaf = true;
+        // numeric leaf required; leaves only allowed at the deepest level
+        if (static_cast<int>(shape->size()) != depth + 1) return false;
+        long long tok_start = i;
+        if (i < n && (s[i] == 'N' || s[i] == 'I' ||
+                      (s[i] == '-' && i + 1 < n && s[i + 1] == 'I'))) {
+          // NaN / Infinity / -Infinity
+          double v;
+          if (lit("NaN")) v = std::nan("");
+          else if (lit("Infinity")) v = std::numeric_limits<double>::infinity();
+          else if (lit("-Infinity")) v = -std::numeric_limits<double>::infinity();
+          else return false;
+          t->is_int = false;
+          t->vals.push_back(v);
+        } else if (i < n && (s[i] == '-' || (s[i] >= '0' && s[i] <= '9'))) {
+          bool integral;
+          if (!scan_number(&integral)) return false;  // hard error recorded
+          double d;
+          auto r = std::from_chars(s + tok_start, s + i, d);
+          if (r.ec != std::errc()) return false;
+          t->vals.push_back(d);
+          if (t->is_int && integral) {
+            int64_t iv;
+            auto ri = std::from_chars(s + tok_start, s + i, iv);
+            if (ri.ec != std::errc() || ri.ptr != s + i) {
+              t->is_int = false;  // out of int64 range: fall to float
+            } else {
+              t->ivals.push_back(iv);
+            }
+          } else {
+            t->is_int = false;
+          }
+        } else {
+          return false;  // string/object/bool/null leaf: not a tensor
+        }
+      }
+      count++;
+      skip_ws();
+      if (i < n && s[i] == ',') {
+        i++;
+        continue;
+      }
+      if (i < n && s[i] == ']') {
+        i++;
+        break;
+      }
+      fail("expected ',' or ']'");
+      return false;
+    }
+    if (first_spine) {
+      (*shape)[depth] = count;
+    } else if ((*shape)[depth] != count) {
+      return false;  // ragged
+    }
+    return true;
+  }
+
+  void emit_placeholder(size_t k) {
+    out += "\"\\u0007";
+    out += nonce;
+    out += ':';
+    out += std::to_string(k);
+    out += '"';
+  }
+
+  void value(int depth) {
+    if (!ok) return;
+    if (depth >= kMaxDepth) {
+      declined = true;  // valid JSON may continue deeper: let json.loads try
+      fail("nesting too deep");
+      return;
+    }
+    skip_ws();
+    if (i >= n) {
+      fail("unexpected end");
+      return;
+    }
+    char c = s[i];
+    if (c == '{') {
+      out += '{';
+      i++;
+      skip_ws();
+      if (i < n && s[i] == '}') {
+        i++;
+        out += '}';
+        return;
+      }
+      while (ok) {
+        skip_ws();
+        if (i >= n || s[i] != '"') {
+          fail("expected object key");
+          return;
+        }
+        long long key_start = i;
+        if (!scan_string()) return;
+        out.append(s + key_start, i - key_start);
+        skip_ws();
+        if (i >= n || s[i] != ':') {
+          fail("expected ':'");
+          return;
+        }
+        i++;
+        out += ':';
+        value(depth + 1);
+        if (!ok) return;
+        skip_ws();
+        if (i < n && s[i] == ',') {
+          i++;
+          out += ',';
+          continue;
+        }
+        if (i < n && s[i] == '}') {
+          i++;
+          out += '}';
+          return;
+        }
+        fail("expected ',' or '}'");
+        return;
+      }
+      return;
+    }
+    if (c == '[') {
+      Tensor t;
+      long long before = i;
+      if (try_tensor(&t) && ok) {
+        long long total = static_cast<long long>(t.vals.size());
+        if (total >= kTensorMinElems) {
+          tensors.push_back(std::move(t));
+          emit_placeholder(tensors.size() - 1);
+          return;
+        }
+        // parsed fine but small: keep the original text span verbatim
+        out.append(s + before, i - before);
+        return;
+      }
+      if (!ok) return;
+      // generic array
+      out += '[';
+      i++;
+      skip_ws();
+      if (i < n && s[i] == ']') {
+        i++;
+        out += ']';
+        return;
+      }
+      while (ok) {
+        value(depth + 1);
+        if (!ok) return;
+        skip_ws();
+        if (i < n && s[i] == ',') {
+          i++;
+          out += ',';
+          continue;
+        }
+        if (i < n && s[i] == ']') {
+          i++;
+          out += ']';
+          return;
+        }
+        fail("expected ',' or ']'");
+        return;
+      }
+      return;
+    }
+    if (c == '"') {
+      long long start = i;
+      if (!scan_string()) return;
+      out.append(s + start, i - start);
+      return;
+    }
+    if (lit("true")) { out += "true"; return; }
+    if (lit("false")) { out += "false"; return; }
+    if (lit("null")) { out += "null"; return; }
+    if (lit("NaN")) { out += "NaN"; return; }
+    if (lit("Infinity")) { out += "Infinity"; return; }
+    if (c == '-' && lit("-Infinity")) { out += "-Infinity"; return; }
+    if (c == '-' || (c >= '0' && c <= '9')) {
+      long long start = i;
+      bool integral;
+      if (!scan_number(&integral)) return;
+      out.append(s + start, i - start);
+      return;
+    }
+    fail("unexpected character");
+  }
+
+  void parse() {
+    value(0);
+    if (!ok) return;
+    skip_ws();
+    if (i != n) fail("trailing data");
+  }
+};
+
+}  // namespace jsonp
+
+extern "C" {
+
+void* tpusc_json_parse(const char* text, long long len, const char* nonce) {
+  auto* p = new jsonp::Parser(text, len, nonce);
+  p->parse();
+  return p;
+}
+
+int tpusc_jp_ok(void* h) { return static_cast<jsonp::Parser*>(h)->ok ? 1 : 0; }
+
+int tpusc_jp_declined(void* h) {
+  return static_cast<jsonp::Parser*>(h)->declined ? 1 : 0;
+}
+
+const char* tpusc_jp_error(void* h) {
+  return static_cast<jsonp::Parser*>(h)->err.c_str();
+}
+
+const char* tpusc_jp_skeleton(void* h, long long* len) {
+  auto* p = static_cast<jsonp::Parser*>(h);
+  *len = static_cast<long long>(p->out.size());
+  return p->out.data();
+}
+
+int tpusc_jp_ntensors(void* h) {
+  return static_cast<int>(static_cast<jsonp::Parser*>(h)->tensors.size());
+}
+
+// -> ndim; shape copied into shape_out (cap entries); is_int + nelems set
+int tpusc_jp_tensor_info(void* h, int k, int* is_int, int64_t* shape_out,
+                         int cap, long long* nelems) {
+  auto& t = static_cast<jsonp::Parser*>(h)->tensors[k];
+  *is_int = t.is_int ? 1 : 0;
+  int ndim = static_cast<int>(t.shape.size());
+  for (int d = 0; d < ndim && d < cap; d++) shape_out[d] = t.shape[d];
+  *nelems = static_cast<long long>(t.vals.size());
+  return ndim;
+}
+
+const void* tpusc_jp_tensor_data(void* h, int k) {
+  auto& t = static_cast<jsonp::Parser*>(h)->tensors[k];
+  return t.is_int ? static_cast<const void*>(t.ivals.data())
+                  : static_cast<const void*>(t.vals.data());
+}
+
+void tpusc_jp_free(void* h) { delete static_cast<jsonp::Parser*>(h); }
+
+}  // extern "C"

@@ -181,7 +181,28 @@ def _binary_tensor_to_array(
         raise CodecError(f"bad binary tensor spec: {e}") from e
 
 
+def loads_request(body: bytes):
+    """Parse a JSON request body: the native parser (dense numeric subtrees
+    arrive as ready numpy arrays, skipping per-number Python objects) with a
+    ``json.loads`` fallback. Raises ValueError (of which JSONDecodeError is a
+    subclass) on malformed bodies either way."""
+    from tfservingcache_tpu import native
+
+    parsed = native.json_parse_request(body)
+    if parsed is not None:
+        return parsed
+    return json.loads(body)
+
+
 def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
+    if isinstance(value, np.ndarray):
+        # pre-extracted by the native request parser; apply the same dtype
+        # rules the list path below ends with
+        if dtype is not None:
+            return value.astype(dtype) if value.dtype != dtype else value
+        if value.dtype == np.float64:
+            return value.astype(np.float32)
+        return value
     if _is_binary_spec(value):
         return _binary_tensor_to_array(value, dtype)
     if isinstance(value, list) and value and all(_is_binary_spec(v) for v in value):
@@ -250,6 +271,19 @@ def decode_predict_json(
 
     if "instances" in body:
         instances = body["instances"]
+        if isinstance(instances, np.ndarray):
+            # native-parser extraction: a dense numeric instances array IS
+            # the stacked single-input row format already
+            if instances.size == 0:
+                raise CodecError('"instances" must be a non-empty list')
+            if len(input_dtypes) == 1:
+                (only_name,) = input_dtypes.keys()
+            else:
+                only_name = default_input
+            return (
+                {only_name: _value_to_array(instances, dtype_for(only_name))},
+                signature,
+            )
         if not isinstance(instances, list) or not instances:
             raise CodecError('"instances" must be a non-empty list')
         if isinstance(instances[0], dict) and "b64" not in instances[0]:

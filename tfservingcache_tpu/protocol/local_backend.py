@@ -424,8 +424,11 @@ class LocalServingBackend(ServingBackend):
                 f"unsupported {method} {verb or ''} request", grpc.StatusCode.UNIMPLEMENTED, 405
             )
         try:
-            payload = json.loads(body or b"{}")
-        except json.JSONDecodeError as e:
+            # native parse (dense tensors -> numpy without per-number Python
+            # objects), in the executor so a 100 KB body can't stall the
+            # event loop; ValueError covers both parsers' failures
+            payload = await self._run(codec.loads_request, body or b"{}")
+        except ValueError as e:
             raise BackendError(f"invalid JSON body: {e}", grpc.StatusCode.INVALID_ARGUMENT, 400) from e
 
         if verb == "predict":
@@ -511,7 +514,16 @@ class LocalServingBackend(ServingBackend):
         client (VERDICT r2 weak #7).
         """
         ids = payload.get("input_ids")
-        if not isinstance(ids, list) or not ids:
+        if isinstance(ids, np.ndarray):
+            # pre-extracted by the native request parser; float arrays stay
+            # admissible for parity with the list path (np.asarray(..., int32)
+            # downstream truncates either way)
+            if ids.size == 0 or ids.dtype.kind not in "iuf":
+                raise BackendError(
+                    '"input_ids" must be a non-empty 2-D list of token ids',
+                    grpc.StatusCode.INVALID_ARGUMENT, 400,
+                )
+        elif not isinstance(ids, list) or not ids:
             raise BackendError(
                 '"input_ids" must be a non-empty 2-D list',
                 grpc.StatusCode.INVALID_ARGUMENT, 400,
@@ -588,6 +600,8 @@ class LocalServingBackend(ServingBackend):
             pb_ex = inp.example_list.examples.add()
             for fname, val in ex.items():
                 feat = pb_ex.features.feature[fname]
+                if isinstance(val, np.ndarray):  # native-parser extraction
+                    val = val.tolist()
                 vals = val if isinstance(val, list) else [val]
                 if all(isinstance(v, (int, np.integer)) for v in vals):
                     feat.int64_list.value.extend(int(v) for v in vals)
