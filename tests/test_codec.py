@@ -373,3 +373,20 @@ def test_loads_request_reviewer_repros():
     deep = b'[' * 33 + b",".join(b"%d" % i for i in range(64)) + b']' * 33
     body = b'{"t": ' + deep + b'}'
     np.testing.assert_equal(norm(loads_request(body)), json.loads(body))
+
+
+def test_native_encoder_byte_parity_with_json_dumps():
+    """Responses must be byte-identical to the json.dumps path for finite
+    values (", " separators, integral floats as "3.0") — deploy smoke
+    asserts on exact response text, and json.loads must keep float-typing."""
+    from tfservingcache_tpu import native
+
+    if not native.native_available():
+        pytest.skip("native tier unavailable")
+    for arr in (
+        np.array([[2.5, 3.0], [4.5, -0.125]], np.float32),
+        np.array([1, 2, 3], np.int64),
+        np.array([[True], [False]]),
+        np.arange(6, dtype=np.float64).reshape(2, 3),
+    ):
+        assert native.json_encode_array(arr) == json.dumps(arr.tolist()).encode()

@@ -469,7 +469,14 @@ inline char* write_num(char* p, T v) {
 template <typename T>
 inline char* write_float(char* p, T v) {
   if (std::isfinite(v)) {
-    auto r = std::to_chars(p, p + 32, v);
+    auto r = std::to_chars(p, p + 30, v);
+    // json.dumps prints integral floats as "3.0", and json.loads turns a
+    // bare "3" into an int — keep the float-typedness on the wire
+    bool has_mark = false;
+    for (char* q = p; q != r.ptr; ++q) {
+      if (*q == '.' || *q == 'e' || *q == 'E') { has_mark = true; break; }
+    }
+    if (!has_mark) { *r.ptr++ = '.'; *r.ptr++ = '0'; }
     return r.ptr;
   }
   const char* s = std::isnan(v) ? "NaN" : (v > 0 ? "Infinity" : "-Infinity");
@@ -493,7 +500,7 @@ char* enc_dim(const T*& d, const int64_t* shape, int ndim, int dim, char* p,
   }
   *p++ = '[';
   for (int64_t i = 0; i < shape[dim]; ++i) {
-    if (i) *p++ = ',';
+    if (i) { *p++ = ','; *p++ = ' '; }  // ", " = json.dumps's default
     p = enc_dim(d, shape, ndim, dim + 1, p, w);
   }
   *p++ = ']';
@@ -509,8 +516,8 @@ long long enc_typed(const void* data, const int64_t* shape, int ndim,
     if (k + 1 < ndim) brackets += n;
   }
   if (ndim == 0) brackets = 0;
-  // worst case: every element + separator, every bracket pair, slack
-  long long bound = n * (per_elem + 1) + brackets * 2 + 16;
+  // worst case: every element + ", " separator + ".0", every bracket pair
+  long long bound = n * (per_elem + 4) + brackets * 2 + 16;
   if (bound > cap) return -bound;  // caller retries with the returned size
   const T* d = static_cast<const T*>(data);
   char* p = enc_dim(d, shape, ndim, 0, out, w);
