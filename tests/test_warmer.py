@@ -1,0 +1,94 @@
+"""AssignmentWarmer: on membership change, owned on-disk models are made
+servable before traffic; un-owned and not-on-disk models are left alone.
+(No reference counterpart — the reference cold-loads on first request,
+cluster.go:116-130; SURVEY §7 hard part (a) makes warming load-bearing.)"""
+
+import time
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.cluster.warmer import AssignmentWarmer
+from tfservingcache_tpu.runtime.fake import FakeRuntime
+from tfservingcache_tpu.types import ModelId, NodeInfo
+
+
+def make_store(root, models):
+    for name, version, nbytes in models:
+        d = root / name / str(version)
+        d.mkdir(parents=True)
+        (d / "params.bin").write_bytes(b"p" * nbytes)
+    return DiskModelProvider(str(root))
+
+
+class RingStub:
+    """find_nodes_for_key by a fixed key->idents mapping."""
+
+    def __init__(self, owners_by_key):
+        self.owners_by_key = owners_by_key
+
+    def find_nodes_for_key(self, key):
+        return [
+            NodeInfo("h", 1, int(i)) for i in self.owners_by_key.get(key, [])
+        ]
+
+
+def wait_for(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def make_stack(tmp_path):
+    provider = make_store(
+        tmp_path / "store", [("a", 1, 10), ("b", 1, 10), ("c", 1, 10)]
+    )
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime()
+    manager = CacheManager(provider, cache, runtime)
+    return manager, runtime
+
+
+def ident(port):  # NodeInfo("h", 1, port).ident
+    return NodeInfo("h", 1, port).ident
+
+
+def test_owned_on_disk_models_are_warmed(tmp_path):
+    manager, runtime = make_stack(tmp_path)
+    # a and b on local disk; only a owned by self; c owned but NOT on disk
+    manager.prefetch(ModelId("a", 1))
+    manager.prefetch(ModelId("b", 1))
+    self_id = ident(7001)
+    ring = RingStub({"a##1": [7001, 7002], "b##1": [7002], "c##1": [7001]})
+    w = AssignmentWarmer(ring, [(self_id, manager)])
+    try:
+        w.on_update([])
+        assert wait_for(lambda: runtime.is_loaded(ModelId("a", 1)))
+        time.sleep(0.05)  # give a wrong warm a chance to happen
+        assert not runtime.is_loaded(ModelId("b", 1))  # not owned
+        assert not runtime.is_loaded(ModelId("c", 1))  # owned, not on disk
+        assert runtime.loads == [ModelId("a", 1)]
+    finally:
+        w.close()
+
+
+def test_rewarm_after_remap(tmp_path):
+    manager, runtime = make_stack(tmp_path)
+    manager.prefetch(ModelId("a", 1))
+    manager.prefetch(ModelId("b", 1))
+    self_id = ident(7001)
+    ring = RingStub({"a##1": [7001]})
+    w = AssignmentWarmer(ring, [(self_id, manager)])
+    try:
+        w.on_update([])
+        assert wait_for(lambda: runtime.is_loaded(ModelId("a", 1)))
+        # remap: b now owned too; a stays warm, b gets loaded on next update
+        ring.owners_by_key["b##1"] = [7001]
+        w.on_update([])
+        assert wait_for(lambda: runtime.is_loaded(ModelId("b", 1)))
+        assert runtime.is_loaded(ModelId("a", 1))
+    finally:
+        w.close()

@@ -269,6 +269,15 @@ class Router:
             self.backend, metrics, require_version=True, metrics_path=cfg.metrics.path
         )
         self.grpc = GrpcServingServer(self.backend, metrics, cfg.proxy.grpc_max_message_bytes)
+        self.warmer = None
+        if node is not None and cfg.proxy.warm_on_assignment:
+            from tfservingcache_tpu.cluster.warmer import AssignmentWarmer
+
+            self.warmer = AssignmentWarmer(
+                self.cluster,
+                [(n.ident, g.manager) for n, g in zip(self.self_nodes, node.groups)],
+            )
+            self.cluster.on_update.append(self.warmer.on_update)
         self._health_task: asyncio.Task | None = None
 
     async def start(self) -> tuple[int, int]:
@@ -299,6 +308,9 @@ class Router:
     async def close(self) -> None:
         if self._health_task is not None:
             self._health_task.cancel()
+        if self.warmer is not None:
+            # blocking join: keep the event loop free for the teardown below
+            await asyncio.to_thread(self.warmer.close)
         await self.cluster.disconnect()
         await self.backend.close()
         await self.rest.close()

@@ -78,6 +78,9 @@ class ProxyConfig:
     grpc_port: int = 8100
     replicas_per_model: int = 1
     grpc_max_message_bytes: int = 16 << 20   # reference cachemanager.go:230-233
+    # on membership change, pre-load owned models already in the local disk
+    # cache (cluster/warmer.py; no reference counterpart — SURVEY §7 (a))
+    warm_on_assignment: bool = True
 
 
 @dataclass
