@@ -317,22 +317,10 @@ def bench_cold(family: str, tenants: int, batch: int, tmp: str,
     return stats, manager, runtime, inputs
 
 
-async def _rest_warm_qps(manager, family: str, variants: list[dict],
-                         duration_s: float, clients: int,
-                         batch_window_ms: float, verb: str = "predict",
-                         gen_tokens: int = 16) -> float:
-    """Concurrent warm QPS through the real REST server: aiohttp clients
-    hammer the verb for duration_s, cycling distinct payloads."""
-    import asyncio
-
-    import aiohttp
-
-    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
-    from tfservingcache_tpu.protocol.rest import RestServingServer
-
-    backend = LocalServingBackend(manager, batch_window_ms=batch_window_ms)
-    rest = RestServingServer(backend, require_version=False)
-    port = await rest.start(0, host="127.0.0.1")
+def _rest_bodies(variants: list[dict], verb: str, gen_tokens: int) -> list[bytes]:
+    """Pre-serialized ONCE: the single-core harness shares the client and the
+    server; re-encoding a 60 KB body per post would bill client work to the
+    server's measured QPS."""
     if verb == "generate":
         bodies = [
             {"input_ids": v["input_ids"][:, :32].tolist(),
@@ -343,12 +331,21 @@ async def _rest_warm_qps(manager, family: str, variants: list[dict],
         bodies = [
             {"inputs": {k: a.tolist() for k, a in v.items()}} for v in variants
         ]
-    # pre-serialize ONCE: the single-core harness shares the client and the
-    # server; re-encoding a 60 KB body per post would bill client work to
-    # the server's measured QPS
-    bodies = [json.dumps(b).encode() for b in bodies]
+    return [json.dumps(b).encode() for b in bodies]
+
+
+async def _hammer_rest(port: int, bodies: list[bytes], duration_s: float,
+                       clients: int, verb: str = "predict",
+                       model: str = "tenant0") -> float:
+    """Concurrent QPS loop against an already-running REST port, cycling
+    distinct payloads (identical repeats can be answered from transport
+    caches on a remote-attached TPU)."""
+    import asyncio
+
+    import aiohttp
+
     headers = {"Content-Type": "application/json"}
-    url = f"http://127.0.0.1:{port}/v1/models/tenant0/versions/1:{verb}"
+    url = f"http://127.0.0.1:{port}/v1/models/{model}/versions/1:{verb}"
     counts = [0] * clients
     stop = 0.0  # set after the settle phase
 
@@ -378,9 +375,61 @@ async def _rest_warm_qps(manager, family: str, variants: list[dict],
         stop = t0 + duration_s
         await asyncio.gather(*(worker(i, session) for i in range(clients)))
         dt = time.perf_counter() - t0
-    await rest.close()
-    backend.close()
     return sum(counts) / dt
+
+
+async def _rest_warm_qps(manager, family: str, variants: list[dict],
+                         duration_s: float, clients: int,
+                         batch_window_ms: float, verb: str = "predict",
+                         gen_tokens: int = 16) -> float:
+    """Concurrent warm QPS through the real REST server: aiohttp clients
+    hammer the verb for duration_s, cycling distinct payloads."""
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.rest import RestServingServer
+
+    backend = LocalServingBackend(manager, batch_window_ms=batch_window_ms)
+    rest = RestServingServer(backend, require_version=False)
+    port = await rest.start(0, host="127.0.0.1")
+    bodies = _rest_bodies(variants, verb, gen_tokens)
+    try:
+        return await _hammer_rest(port, bodies, duration_s, clients, verb)
+    finally:
+        await rest.close()
+        backend.close()
+
+
+async def _routed_warm_qps(tmp: str, variants: list[dict], duration_s: float,
+                           clients: int) -> float:
+    """Warm QPS through the FULL routed path — router REST -> ring lookup ->
+    local-group short-circuit -> cache node -> runtime — the reference's
+    headline topology (taskhandler.go:95-114), which the per-layer QPS rows
+    above skip."""
+    from tfservingcache_tpu.cluster.router import Router
+    from tfservingcache_tpu.config import Config
+    from tfservingcache_tpu.server import CacheNode
+
+    cfg = Config()
+    cfg.model_provider.type = "disk"
+    cfg.model_provider.base_dir = os.path.join(tmp, "store-mnist_cnn")
+    cfg.cache.base_dir = os.path.join(tmp, "cache-routed")
+    cfg.cache_node.rest_port = 0
+    cfg.cache_node.grpc_port = 0
+    cfg.proxy.rest_port = 0
+    cfg.proxy.grpc_port = 0
+    cfg.discovery.type = "static"
+    cfg.discovery.prefer_localhost = True
+    cfg.serving.compile_cache_dir = os.path.expanduser("~/.cache/tpusc-xla")
+    node = CacheNode(cfg)
+    await node.start()
+    router = Router(cfg, node)
+    rr_port, _ = await router.start()
+    try:
+        return await _hammer_rest(
+            rr_port, _rest_bodies(variants, "predict", 0), duration_s, clients
+        )
+    finally:
+        await router.close()
+        await node.close()
 
 
 async def _grpc_warm_qps(manager, variants: list[dict], duration_s: float,
@@ -717,6 +766,16 @@ def run(args) -> dict:
             )
         detail["mnist_cnn"][key] = round(qps, 1)
     manager.close()
+
+    # full routed path (router -> ring -> cache node), its own node + runtime
+    try:
+        with _section("mnist_routed_qps"):
+            qps = asyncio.run(
+                _routed_warm_qps(tmp, mnist_variants, args.warm_s, args.clients)
+            )
+        detail["mnist_cnn"]["routed_rest_qps"] = round(qps, 1)
+    except Exception as e:  # noqa: BLE001 - the direct rows stand on their own
+        detail["mnist_cnn"]["routed_rest_qps_error"] = f"{type(e).__name__}: {e}"
 
     # --- transformer_lm: prefill/decode + REST/gRPC/:generate ---
     lm_variants = _input_variants("transformer_lm", args.lm_batch, lm_config)
