@@ -276,6 +276,7 @@ class Router:
             self.warmer = AssignmentWarmer(
                 self.cluster,
                 [(n.ident, g.manager) for n, g in zip(self.self_nodes, node.groups)],
+                metrics=metrics,
             )
             self.cluster.on_update.append(self.warmer.on_update)
         self._health_task: asyncio.Task | None = None

@@ -32,11 +32,13 @@ log = get_logger("cluster.warmer")
 
 
 class AssignmentWarmer:
-    def __init__(self, cluster, groups: list[tuple[str, object]]) -> None:
+    def __init__(self, cluster, groups: list[tuple[str, object]],
+                 metrics=None) -> None:
         """``cluster`` needs ``find_nodes_for_key``; ``groups`` pairs each
         local ring-member ident with its group's CacheManager."""
         self.cluster = cluster
         self.groups = groups
+        self.metrics = metrics
         self._wake = threading.Event()
         self._stop = False
         self._generation = 0
@@ -84,6 +86,8 @@ class AssignmentWarmer:
                 try:
                     manager.ensure_servable(mid)
                     self.warmed += 1
+                    if self.metrics is not None:
+                        self.metrics.assignment_warms.inc()
                 except Exception as e:  # noqa: BLE001
                     # a failed warm costs nothing: the request path retries
                     log.warning("assignment warm of %s failed: %s", mid, e)

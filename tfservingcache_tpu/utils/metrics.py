@@ -100,6 +100,11 @@ class Metrics:
             "tpusc_coalesced_requests", "Requests served via a coalesced call",
             ["kind"], registry=r,
         )
+        self.assignment_warms = Counter(
+            "tpusc_assignment_warms_total",
+            "Models pre-loaded by the ring-assignment warmer",
+            registry=r,
+        )
 
     def model_label(self, name: str, version: int | str) -> str:
         if self.model_labels:
