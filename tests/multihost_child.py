@@ -1,11 +1,12 @@
 """Child process for the cross-host chip-group test (tests/test_multihost.py).
 
 Each invocation is one 'host': 4 virtual CPU devices, jax.distributed
-rendezvous, one 8-chip TP group spanning both processes. Process 0 leads the
-group (binds its REST server, answers requests); process 1 runs only the
-group-work service and joins the collectives.
+rendezvous, one (4 x nprocs)-chip TP group spanning all processes (the
+BASELINE config-#5 topology is 4 hosts x 4 chips). Process 0 leads the
+group (binds its REST server, answers requests); the others run only the
+group-work service and join the collectives.
 
-argv: process_id coordinator_port worker0_port worker1_port store_dir run_dir
+argv: process_id coordinator_port worker_port... store_dir run_dir
 """
 
 import os
@@ -13,8 +14,8 @@ import sys
 
 pid = int(sys.argv[1])
 coord = sys.argv[2]
-w0, w1 = sys.argv[3], sys.argv[4]
-store, run_dir = sys.argv[5], sys.argv[6]
+worker_ports = sys.argv[3:-2]
+store, run_dir = sys.argv[-2], sys.argv[-1]
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -35,12 +36,12 @@ async def main() -> None:
     cfg.cache.base_dir = os.path.join(run_dir, f"cache_{pid}")
     cfg.cache_node.rest_port = 0
     cfg.cache_node.grpc_port = 0
-    cfg.serving.load_timeout_s = 120.0
-    cfg.mesh.chips_per_group = 8
+    cfg.serving.load_timeout_s = 240.0
+    cfg.mesh.chips_per_group = 4 * len(worker_ports)
     cfg.mesh.coordinator = f"127.0.0.1:{coord}"
-    cfg.mesh.num_processes = 2
+    cfg.mesh.num_processes = len(worker_ports)
     cfg.mesh.process_id = pid
-    cfg.mesh.worker_addrs = [f"127.0.0.1:{w0}", f"127.0.0.1:{w1}"]
+    cfg.mesh.worker_addrs = [f"127.0.0.1:{w}" for w in worker_ports]
 
     from tfservingcache_tpu.server import CacheNode
 
@@ -55,11 +56,11 @@ async def main() -> None:
         await asyncio.Event().wait()
         return
 
-    # leader: the group's mesh must really span both processes
+    # leader: the group's mesh must really span every process
     assert len(node.groups) == 1
     mesh = node.groups[0].manager.runtime.mesh
     procs = {d.process_index for d in mesh.devices.flat}
-    assert procs == {0, 1}, procs
+    assert procs == set(range(len(worker_ports))), procs
     print("LEADER READY", flush=True)
 
     import aiohttp

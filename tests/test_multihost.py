@@ -30,7 +30,11 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-def test_two_process_group_serves_with_parity(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_cross_host_group_serves_with_parity(tmp_path, nprocs):
     # export the artifact ONCE; both 'hosts' read the same store (in prod:
     # shared object storage), each keeps its own disk cache
     env = dict(os.environ)
@@ -51,8 +55,10 @@ def test_two_process_group_serves_with_parity(tmp_path):
         check=True, env=env, cwd=REPO, timeout=120,
     )
 
-    coord, w0, w1 = _free_ports(3)
-    args = [str(coord), str(w0), str(w1), str(tmp_path / "store"), str(tmp_path)]
+    ports = _free_ports(1 + nprocs)
+    coord, workers = ports[0], ports[1:]
+    args = [str(coord), *[str(w) for w in workers],
+            str(tmp_path / "store"), str(tmp_path)]
     child_env = dict(os.environ)
     child_env.pop("XLA_FLAGS", None)
     child_env["PYTHONPATH"] = REPO + os.pathsep + child_env.get("PYTHONPATH", "")
@@ -62,21 +68,26 @@ def test_two_process_group_serves_with_parity(tmp_path):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=child_env, cwd=REPO,
         )
-        for pid in (0, 1)
+        for pid in range(nprocs)
     ]
+    outs = [""] * nprocs
     try:
-        out0, _ = procs[0].communicate(timeout=420)
+        outs[0], _ = procs[0].communicate(timeout=600)
     except subprocess.TimeoutExpired:
         procs[0].kill()
-        out0 = procs[0].communicate()[0]
-        pytest.fail(f"leader timed out; output:\n{out0[-4000:]}")
+        outs[0] = procs[0].communicate()[0]
+        pytest.fail(f"leader timed out; output:\n{outs[0][-4000:]}")
     finally:
-        procs[1].terminate()
-        try:
-            out1, _ = procs[1].communicate(timeout=30)
-        except subprocess.TimeoutExpired:
-            procs[1].kill()
-            out1 = procs[1].communicate()[0]
-    assert procs[0].returncode == 0, f"leader:\n{out0[-4000:]}\nfollower:\n{out1[-4000:]}"
-    assert "MULTIHOST PARITY OK" in out0
-    assert "FOLLOWER READY" in out1
+        for i in range(1, nprocs):
+            procs[i].terminate()
+            try:
+                outs[i], _ = procs[i].communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                procs[i].kill()
+                outs[i] = procs[i].communicate()[0]
+    assert procs[0].returncode == 0, "\n".join(
+        f"proc{i}:\n{o[-3000:]}" for i, o in enumerate(outs)
+    )
+    assert "MULTIHOST PARITY OK" in outs[0]
+    for i in range(1, nprocs):
+        assert "FOLLOWER READY" in outs[i], outs[i][-2000:]
