@@ -531,7 +531,8 @@ def bench_lm_throughput(runtime, variants: list[dict], batch: int,
 
 
 def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
-                     seq: int = 512) -> dict:
+                     seq: int = 512, config: dict | None = None,
+                     decode_batches: tuple = (1, 8, 32)) -> dict:
     """Chip-sized LM (~284 M params): prefill MFU via chained on-device
     timing of the jitted forward, decode tok/s at batch 1/8/32."""
     import numpy as np
@@ -539,7 +540,7 @@ def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
     from tfservingcache_tpu.types import ModelId
     from tfservingcache_tpu.utils.benchtime import chained_device_time
 
-    cfg = LM_CHIP_CONFIG
+    cfg = config or LM_CHIP_CONFIG
     manager, runtime = _make_stack("transformer_lm", 1, tmp, hbm_gb=12,
                                    config=cfg)
     mid = ModelId("tenant0", 1)
@@ -579,7 +580,7 @@ def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
     # decode curve: wall-clock generate (prompt 128, 32 new tokens), varied
     # prompts per call
     rng = np.random.default_rng(4)
-    for b in (1, 8, 32):
+    for b in decode_batches:
         prompts = [
             rng.integers(0, cfg["vocab_size"], (b, 128)).astype(np.int32)
             for _ in range(3)
