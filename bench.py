@@ -75,9 +75,13 @@ def probe_backend(timeout_s: float, attempts: int = 3) -> tuple[str, str]:
     last = ""
     for attempt in range(attempts):
         try:
+            # full patience once; retries get less — a wedged tunnel would
+            # otherwise eat ~3 x timeout_s of the watchdog budget before the
+            # CPU fallback even starts
             r = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout_s,
+                capture_output=True, text=True,
+                timeout=timeout_s if attempt == 0 else min(timeout_s, 90.0),
             )
             if r.returncode == 0 and r.stdout.strip():
                 info = json.loads(r.stdout.strip().splitlines()[-1])
