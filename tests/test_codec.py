@@ -390,3 +390,62 @@ def test_native_encoder_byte_parity_with_json_dumps():
         np.arange(6, dtype=np.float64).reshape(2, 3),
     ):
         assert native.json_encode_array(arr) == json.dumps(arr.tolist()).encode()
+
+
+def test_loads_request_fuzz_parity_with_json_loads():
+    """Deterministic fuzz: 300 generated JSON documents (nested objects,
+    arrays, dense/ragged numeric lists, strings with escapes, specials)
+    must parse identically to json.loads — the C parser either agrees or
+    declines to the stdlib, never silently diverges."""
+    import random
+
+    from tfservingcache_tpu.protocol.codec import loads_request
+
+    rng = random.Random(20260730)
+
+    def gen_value(depth):
+        kinds = ["num", "int", "str", "bool", "null", "numlist"]
+        if depth < 4:
+            kinds += ["obj", "arr", "numlist2d"]
+        k = rng.choice(kinds)
+        if k == "num":
+            return round(rng.uniform(-1e6, 1e6), rng.randint(0, 6))
+        if k == "int":
+            return rng.randint(-10**12, 10**12)
+        if k == "str":
+            chars = 'ab\\"' + chr(10) + chr(9) + chr(233) + ' 0:'
+            return "".join(rng.choice(chars) for _ in range(rng.randint(0, 8)))
+        if k == "bool":
+            return rng.random() < 0.5
+        if k == "null":
+            return None
+        if k == "numlist":
+            n = rng.choice([0, 3, 70])  # straddle the 64-elem extraction gate
+            return [rng.choice([rng.randint(-9, 9), rng.uniform(-1, 1)]) for _ in range(n)]
+        if k == "numlist2d":
+            rows, cols = rng.randint(1, 3), rng.choice([2, 40])
+            out = [[rng.uniform(-1, 1) for _ in range(cols)] for _ in range(rows)]
+            if rng.random() < 0.3 and rows > 1:
+                out[-1] = out[-1][:-1]  # ragged: must decline, not corrupt
+            return out
+        if k == "obj":
+            return {
+                f"k{i}": gen_value(depth + 1) for i in range(rng.randint(0, 4))
+            }
+        return [gen_value(depth + 1) for i in range(rng.randint(0, 4))]
+
+    def norm(v):
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, dict):
+            return {k: norm(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [norm(x) for x in v]
+        return v
+
+    for i in range(300):
+        doc = gen_value(0)
+        body = json.dumps(doc).encode()
+        got = norm(loads_request(body))
+        want = json.loads(body)
+        np.testing.assert_equal(got, want)
