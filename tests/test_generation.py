@@ -452,3 +452,49 @@ async def test_rest_generate_moe(tmp_path):
     finally:
         backend.close()
         mgr.close()
+
+
+async def test_rest_and_grpc_predict_deadline_504(tmp_path, monkeypatch):
+    """A wedged device call in PREDICT (e.g. the accelerator transport
+    dropping mid-serving) answers 504 at load_timeout_s on both protocols
+    instead of holding the connection forever — same bound :generate and
+    the cold path already honor."""
+    import threading
+
+    from tfservingcache_tpu.protocol.backend import BackendError
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
+    from tfservingcache_tpu.types import ModelId
+
+    mgr, rt = _lm_stack(tmp_path)
+    mgr.ensure_servable(ModelId("lm", 1))
+    mgr.load_timeout_s = 0.5
+    release = threading.Event()  # frees the wedged threads at teardown
+
+    def slow_predict(*a, **kw):
+        release.wait(30.0)
+        raise RuntimeError("released")
+
+    monkeypatch.setattr(rt, "predict", slow_predict)
+    backend = LocalServingBackend(mgr, batch_window_ms=0.0)
+    try:
+        body = json.dumps({"inputs": {"input_ids": [[1, 2, 3]]}}).encode()
+        with pytest.raises(BackendError) as ei:
+            await backend.handle_rest("POST", "lm", 1, "predict", body)
+        assert ei.value.http_status == 504
+
+        req = sv.PredictRequest()
+        req.model_spec.name = "lm"
+        req.model_spec.version.value = 1
+        t = req.inputs["input_ids"]
+        t.dtype = 9  # DT_INT64
+        t.tensor_shape.dim.add().size = 1
+        t.tensor_shape.dim.add().size = 3
+        t.int64_val.extend([1, 2, 3])
+        with pytest.raises(BackendError) as ei:
+            await backend.predict(req)
+        assert ei.value.http_status == 504
+    finally:
+        release.set()
+        backend.close()
+        mgr.close()

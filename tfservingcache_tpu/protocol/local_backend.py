@@ -105,6 +105,30 @@ class LocalServingBackend(ServingBackend):
             self._pool, lambda: ctx.run(fn, *args)
         )
 
+    async def _run_bounded(self, what: str, model_id, fn, *args):
+        """_run with the client's end-to-end deadline. ``load_timeout_s``
+        bounds the CLIENT's total wait — executor-queue time + cold load +
+        compile + device call — so a wedged device (or a saturated pool)
+        answers 504 instead of holding the connection forever; the cold
+        path's inner deadline shares the same clock, this outer one is the
+        backstop when the device call itself hangs. The executor thread is
+        NOT interrupted: the 504 is about the client's bound, stragglers
+        finish (or hang) in the pool."""
+        fut = self._run(fn, *args)
+        timeout = self.manager.load_timeout_s
+        try:
+            return await (asyncio.wait_for(fut, timeout) if timeout else fut)
+        except (TimeoutError, asyncio.TimeoutError):
+            # both spellings: asyncio.TimeoutError is the builtin only since
+            # 3.11, and with the deadline disabled this branch can still fire
+            # via a builtin TimeoutError escaping the job (e.g. the generate
+            # coalescer's follower wait, a socket timeout in a provider)
+            bound = f"{timeout:.1f}s" if timeout else "an internal"
+            raise BackendError(
+                f"{what} for {model_id} exceeded {bound} deadline",
+                grpc.StatusCode.DEADLINE_EXCEEDED, 504,
+            ) from None
+
     # -- helpers ------------------------------------------------------------
     def _model_id(self, spec: sv.ModelSpec) -> ModelId:
         if not spec.name:
@@ -156,7 +180,9 @@ class LocalServingBackend(ServingBackend):
         except codec.CodecError as e:
             raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
         output_filter = list(request.output_filter) or None
-        outputs = await self._run(self._predict_sync, model_id, inputs, output_filter)
+        outputs = await self._run_bounded(
+            "predict", model_id, self._predict_sync, model_id, inputs, output_filter
+        )
         resp = sv.PredictResponse()
         resp.model_spec.name = model_id.name
         resp.model_spec.version.value = model_id.version
@@ -246,7 +272,9 @@ class LocalServingBackend(ServingBackend):
 
     async def classify(self, request: sv.ClassificationRequest) -> sv.ClassificationResponse:
         model_id = self._model_id(request.model_spec)
-        result = await self._run(self._classify_sync, model_id, request.input)
+        result = await self._run_bounded(
+            "classify", model_id, self._classify_sync, model_id, request.input
+        )
         resp = sv.ClassificationResponse()
         resp.result.CopyFrom(result)
         resp.model_spec.name = model_id.name
@@ -273,7 +301,9 @@ class LocalServingBackend(ServingBackend):
 
     async def regress(self, request: sv.RegressionRequest) -> sv.RegressionResponse:
         model_id = self._model_id(request.model_spec)
-        result = await self._run(self._regress_sync, model_id, request.input)
+        result = await self._run_bounded(
+            "regress", model_id, self._regress_sync, model_id, request.input
+        )
         resp = sv.RegressionResponse()
         resp.result.CopyFrom(result)
         resp.model_spec.name = model_id.name
@@ -301,7 +331,7 @@ class LocalServingBackend(ServingBackend):
         self, request: sv.GetModelMetadataRequest
     ) -> sv.GetModelMetadataResponse:
         model_id = self._model_id(request.model_spec)
-        await self._run(self._ensure_sync, model_id)
+        await self._run_bounded("ensure", model_id, self._ensure_sync, model_id)
         sig = self._signature_def(model_id)
         resp = sv.GetModelMetadataResponse()
         resp.model_spec.name = model_id.name
@@ -392,7 +422,7 @@ class LocalServingBackend(ServingBackend):
             fetch = [f.split(":")[0] for f in request.fetch] or None
             return self._predictor.predict(model_id, inputs, fetch)
 
-        outputs = await self._run(run)
+        outputs = await self._run_bounded("session_run", model_id, run)
         resp = sv.SessionRunResponse()
         for name, arr in outputs.items():
             t = resp.tensor.add()
@@ -482,7 +512,7 @@ class LocalServingBackend(ServingBackend):
                 # under tenant churn; reload once and retry
                 return attempt()
 
-        outputs, row = await self._run(lambda: run())
+        outputs, row = await self._run_bounded("predict", model_id, run)
 
         def encode() -> bytes:
             # numeric tensors go through the native C++ JSON encoder (~14x
@@ -568,21 +598,8 @@ class LocalServingBackend(ServingBackend):
             except (ValueError, TypeError) as e:
                 raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
 
-        timeout = self.manager.load_timeout_s
         try:
-            if timeout:
-                tokens = await asyncio.wait_for(self._run(run), timeout)
-            else:
-                tokens = await self._run(run)
-        except (TimeoutError, asyncio.TimeoutError):
-            # both spellings: asyncio.TimeoutError is the builtin only since
-            # 3.11, and with the deadline disabled this branch can still fire
-            # via the coalescer's own follower wait (builtin TimeoutError)
-            bound = f"{timeout:.0f}s" if timeout else "the batch-wait"
-            raise BackendError(
-                f"generate for {model_id} exceeded {bound} deadline",
-                grpc.StatusCode.DEADLINE_EXCEEDED, 504,
-            ) from None
+            tokens = await self._run_bounded("generate", model_id, run)
         except RuntimeError_ as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
         return RestResponse(status=200, body=json.dumps({"tokens": tokens.tolist()}).encode())
@@ -612,13 +629,17 @@ class LocalServingBackend(ServingBackend):
                         v.encode() if isinstance(v, str) else bytes(v) for v in vals
                     )
         if verb == "classify":
-            result = await self._run(self._classify_sync, model_id, inp)
+            result = await self._run_bounded(
+                "classify", model_id, self._classify_sync, model_id, inp
+            )
             rows = [
                 [[c.label, c.score] for c in cls.classes]
                 for cls in result.classifications
             ]
             return RestResponse(status=200, body=json.dumps({"results": rows}).encode())
-        result = await self._run(self._regress_sync, model_id, inp)
+        result = await self._run_bounded(
+            "regress", model_id, self._regress_sync, model_id, inp
+        )
         vals = [r.value for r in result.regressions]
         return RestResponse(status=200, body=json.dumps({"results": vals}).encode())
 
@@ -640,7 +661,7 @@ class LocalServingBackend(ServingBackend):
         return RestResponse(status=200, body=json.dumps(out).encode())
 
     async def _rest_metadata(self, model_id: ModelId) -> RestResponse:
-        await self._run(self._ensure_sync, model_id)
+        await self._run_bounded("ensure", model_id, self._ensure_sync, model_id)
         in_spec, out_spec, method_name = self.manager.runtime.signature(model_id)
 
         def render(spec: Mapping[str, TensorSpec]) -> dict:
