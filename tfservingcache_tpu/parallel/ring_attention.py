@@ -27,9 +27,17 @@ NEG_INF = -1e30
 
 def _block_attend(q, k, v, q_off, k_off, causal, acc, m, l):
     """One online-softmax update of (acc, m, l) with a K/V block at global
-    offset ``k_off`` against Q at global offset ``q_off``. All fp32."""
+    offset ``k_off`` against Q at global offset ``q_off``.
+
+    Operands stay in their INPUT dtype for the dots (bf16 runs at full MXU
+    rate — upcasting first was the same half-rate mistake as the round-2
+    flash kernel); scores/stats accumulate f32 via preferred_element_type,
+    exactly the kernel's recipe (ops/attention.py)."""
     d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    s = jax.lax.dot_general(
+        q, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) / math.sqrt(d)                                      # (B, H, Sq, Sk) f32
     if causal:
         sq, sk = q.shape[2], k.shape[2]
         q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
@@ -39,28 +47,32 @@ def _block_attend(q, k, v, q_off, k_off, causal, acc, m, l):
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m - m_new)
     l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-    acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return acc_new, m_new, l_new
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+    return acc * alpha + pv, m_new, l_new
 
 
 def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool):
-    """Per-shard body under shard_map: local (B, H, S/P, D) blocks."""
+    """Per-shard body under shard_map: local (B, H, S/P, D) blocks. K/V ride
+    the ring in their input dtype — rotating bf16 instead of upcast f32
+    halves the ppermute bytes on ICI."""
     idx = jax.lax.axis_index(axis)
     s_local = q.shape[2]
-    qf = q.astype(jnp.float32)
-    acc = jnp.zeros(qf.shape, jnp.float32)
-    m = jnp.full(qf.shape[:3] + (1,), NEG_INF, jnp.float32)
-    l = jnp.zeros(qf.shape[:3] + (1,), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
     q_off = idx * s_local
 
-    k_cur, v_cur = k.astype(jnp.float32), v.astype(jnp.float32)
+    k_cur, v_cur = k, v
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
     for step in range(n_shards):
         # after `step` rotations, this chip holds the block that started at
         # ring position (idx - step) mod P
         src = (idx - step) % n_shards
         k_off = src * s_local
-        acc, m, l = _block_attend(qf, k_cur, v_cur, q_off, k_off, causal, acc, m, l)
+        acc, m, l = _block_attend(q, k_cur, v_cur, q_off, k_off, causal, acc, m, l)
         if step + 1 < n_shards:
             k_cur = jax.lax.ppermute(k_cur, axis, perm)
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
