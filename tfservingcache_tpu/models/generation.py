@@ -170,14 +170,24 @@ def _forward_cached_dyn(params, input_ids, cache, start_pos, cfg,
         # group x cache bytes every step at exactly the scale GQA exists for)
         d = q.shape[-1]
         group = n_heads // n_kv
-        qg = q.reshape(b, n_kv, group, s_len, d).astype(jnp.float32)
-        s = jnp.einsum("bkgqd,bkld->bkgql", qg, k_cache.astype(jnp.float32))
+        # dots read the caches in their stored dtype: upcasting K/V to f32
+        # here doubled the HBM bytes of the cache read EVERY decode step —
+        # the read that dominates decode. Scores/softmax still accumulate
+        # f32 via preferred_element_type (the flash-kernel recipe).
+        qg = q.reshape(b, n_kv, group, s_len, d)
+        s = jnp.einsum(
+            "bkgqd,bkld->bkgql", qg, k_cache,
+            preferred_element_type=jnp.float32,
+        )
         s = s / math.sqrt(d)
         k_pos = jnp.arange(k_cache.shape[2])
         mask = k_pos[None, None, :] <= positions[:, :, None]      # (B, S, max_len)
         s = jnp.where(mask[:, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.einsum("bkgql,bkld->bkgqd", p, v_cache.astype(jnp.float32))
+        out = jnp.einsum(
+            "bkgql,bkld->bkgqd", p.astype(v_cache.dtype), v_cache,
+            preferred_element_type=jnp.float32,
+        )
         out = out.reshape(b, n_heads, s_len, d).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(b, s_len, cfg["d_model"])
         x = x + out @ attn["wo"]

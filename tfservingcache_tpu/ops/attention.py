@@ -42,13 +42,22 @@ def attention_reference(
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
     g = hq // hkv
-    qg = q.reshape(b, hkv, g, sq, d).astype(jnp.float32)
-    s = jnp.einsum("bkgqd,bkKd->bkgqK", qg, k.astype(jnp.float32)) / math.sqrt(d)
+    # dots in the INPUT dtype (bf16 = full MXU rate, half the HBM reads),
+    # f32 accumulation via preferred_element_type — for f32 inputs this is
+    # bit-for-bit the old upcast math, for bf16 it is the fast path the
+    # flash kernel must honestly beat
+    qg = q.reshape(b, hkv, g, sq, d)
+    s = jnp.einsum(
+        "bkgqd,bkKd->bkgqK", qg, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bkgqK,bkKd->bkgqd", p, v.astype(jnp.float32))
+    o = jnp.einsum(
+        "bkgqK,bkKd->bkgqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return o.reshape(b, hq, sq, d).astype(q.dtype)
 
 
