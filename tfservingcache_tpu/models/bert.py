@@ -60,7 +60,9 @@ def _encoder_layer(p, x, mask_bias, cfg):
     q = (x @ p["wq"] + p["bq"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     k = (x @ p["wk"] + p["bk"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
     v = (x @ p["wv"] + p["bv"]).reshape(b, s, h, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / math.sqrt(hd)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(hd)  # f32 ACCUMULATION, not a bf16-accumulated cast
     scores = scores + mask_bias  # (b,1,1,s) additive -inf on padding
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
     ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -76,6 +76,10 @@ def _moe_block(params: dict, x: jax.Array, cfg: dict) -> tuple[jax.Array, jax.Ar
     )
     # (t, e, c)
 
+    # NOTE: the dispatch gather-matmul stays f32 — bf16 operands change the
+    # EP-sharded cross-device reduction enough to break parity with the
+    # replicated path (tests/test_parallel.py), and routing fidelity beats
+    # the marginal MXU win here
     expert_in = jnp.einsum("tec,td->ecd", dispatch, xt.astype(jnp.float32))
     expert_in = expert_in.astype(x.dtype)
     h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))

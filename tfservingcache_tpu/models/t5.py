@@ -74,7 +74,9 @@ def _attn(p, q_in, kv_in, bias, cfg, extra_mask=None):
     q = (q_in @ p["wq"]).reshape(b, sq, h, hd).transpose(0, 2, 1, 3)
     k = (kv_in @ p["wk"]).reshape(b, sk, h, hd).transpose(0, 2, 1, 3)
     v = (kv_in @ p["wv"]).reshape(b, sk, h, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    )  # f32 accumulation, not a bf16-accumulated cast
     if bias is not None:
         scores = scores + bias
     if extra_mask is not None:

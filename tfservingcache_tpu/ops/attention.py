@@ -54,10 +54,10 @@ def attention_reference(
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum(
-        "bkgqK,bkKd->bkgqd", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
+    # p @ v stays f32: a bf16-rounded p makes the sharded (TP/EP) einsum
+    # diverge from the replicated one beyond parity tolerances — this is
+    # the correctness yardstick, the Pallas kernel is the fast path
+    o = jnp.einsum("bkgqK,bkKd->bkgqd", p, v.astype(jnp.float32))
     return o.reshape(b, hq, sq, d).astype(q.dtype)
 
 
