@@ -403,8 +403,8 @@ async def _rest_warm_qps(manager, family: str, variants: list[dict],
 
 
 async def _routed_warm_qps(tmp: str, variants: list[dict], duration_s: float,
-                           clients: int) -> float:
-    """Warm QPS through the FULL routed path — router REST -> ring lookup ->
+                           clients: int) -> tuple[float, float]:
+    """(REST, gRPC) warm QPS through the FULL routed path — router -> ring ->
     local-group short-circuit -> cache node -> runtime — the reference's
     headline topology (taskhandler.go:95-114), which the per-layer QPS rows
     above skip."""
@@ -426,36 +426,24 @@ async def _routed_warm_qps(tmp: str, variants: list[dict], duration_s: float,
     node = CacheNode(cfg)
     await node.start()
     router = Router(cfg, node)
-    rr_port, _ = await router.start()
+    rr_port, rg_port = await router.start()
     try:
-        return await _hammer_rest(
+        rest = await _hammer_rest(
             rr_port, _rest_bodies(variants, "predict", 0), duration_s, clients
         )
+        grpc_qps = await _hammer_grpc(
+            rg_port, _grpc_requests(variants), duration_s, clients
+        )
+        return rest, grpc_qps
     finally:
         await router.close()
         await node.close()
 
 
-async def _grpc_warm_qps(manager, variants: list[dict], duration_s: float,
-                         clients: int, batch_window_ms: float) -> float:
-    """Concurrent warm QPS through the real gRPC server — the reference's
-    primary protocol (tfservingproxy.go:76-250), unbenched in round 2.
-    TensorProto tensor_content is binary: this is where in-process serving
-    should crush a JSON path."""
-    import asyncio
-
+def _grpc_requests(variants: list[dict]) -> list:
     from tfservingcache_tpu.protocol import codec
-    from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
-    from tfservingcache_tpu.protocol.grpc_server import (
-        PREDICTION_SERVICE,
-        GrpcServingServer,
-    )
-    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
     from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
 
-    backend = LocalServingBackend(manager, batch_window_ms=batch_window_ms)
-    srv = GrpcServingServer(backend)
-    port = await srv.start(0, host="127.0.0.1")
     reqs = []
     for v in variants:
         req = sv.PredictRequest()
@@ -464,6 +452,17 @@ async def _grpc_warm_qps(manager, variants: list[dict], duration_s: float,
         for name, arr in v.items():
             req.inputs[name].CopyFrom(codec.numpy_to_tensorproto(arr))
         reqs.append(req)
+    return reqs
+
+
+async def _hammer_grpc(port: int, reqs: list, duration_s: float,
+                       clients: int) -> float:
+    """Concurrent Predict QPS loop against an already-running gRPC port."""
+    import asyncio
+
+    from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
+    from tfservingcache_tpu.protocol.grpc_server import PREDICTION_SERVICE
+
     channel = make_channel(f"127.0.0.1:{port}")
     stub = ServingStub(channel)
     predict = stub.method(PREDICTION_SERVICE, "Predict")
@@ -484,9 +483,26 @@ async def _grpc_warm_qps(manager, variants: list[dict], duration_s: float,
     await asyncio.gather(*(worker(i) for i in range(clients)))
     dt = time.perf_counter() - t0
     await channel.close()
-    await srv.close()
-    backend.close()
     return sum(counts) / dt
+
+
+async def _grpc_warm_qps(manager, variants: list[dict], duration_s: float,
+                         clients: int, batch_window_ms: float) -> float:
+    """Concurrent warm QPS through the real gRPC server — the reference's
+    primary protocol (tfservingproxy.go:76-250), unbenched in round 2.
+    TensorProto tensor_content is binary: this is where in-process serving
+    should crush a JSON path."""
+    from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+
+    backend = LocalServingBackend(manager, batch_window_ms=batch_window_ms)
+    srv = GrpcServingServer(backend)
+    port = await srv.start(0, host="127.0.0.1")
+    try:
+        return await _hammer_grpc(port, _grpc_requests(variants), duration_s, clients)
+    finally:
+        await srv.close()
+        backend.close()
 
 
 def _lm_param_count(config: dict) -> int:
@@ -775,10 +791,11 @@ def run(args) -> dict:
     # full routed path (router -> ring -> cache node), its own node + runtime
     try:
         with _section("mnist_routed_qps"):
-            qps = asyncio.run(
+            rqps, gqps = asyncio.run(
                 _routed_warm_qps(tmp, mnist_variants, args.warm_s, args.clients)
             )
-        detail["mnist_cnn"]["routed_rest_qps"] = round(qps, 1)
+        detail["mnist_cnn"]["routed_rest_qps"] = round(rqps, 1)
+        detail["mnist_cnn"]["routed_grpc_qps"] = round(gqps, 1)
     except Exception as e:  # noqa: BLE001 - the direct rows stand on their own
         detail["mnist_cnn"]["routed_rest_qps_error"] = f"{type(e).__name__}: {e}"
 
