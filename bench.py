@@ -191,11 +191,30 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
     return manager, runtime
 
 
+# where the live partial lands after every section: a killed/wedged run
+# still leaves committed evidence of everything that finished (VERDICT r3
+# next-round #2 — a 30-minute tunnel window must yield rows, not nothing)
+PARTIAL_OUT = os.environ.get("TPUSC_BENCH_PARTIAL", "")
+
+
+def _dump_partial() -> None:
+    if not PARTIAL_OUT:
+        return
+    try:
+        tmp_path = PARTIAL_OUT + ".tmp"
+        with open(tmp_path, "w") as f:
+            json.dump(PARTIAL, f, default=str)
+        os.replace(tmp_path, PARTIAL_OUT)
+    except OSError:
+        pass
+
+
 @contextlib.contextmanager
 def _section(name: str):
     """Record + print each section's wall time so a budget overrun is
     attributable (the r3 preview burned its whole budget with no trace of
-    where)."""
+    where); flush the live partial to PARTIAL_OUT so even a kill -9 after
+    this section keeps its numbers."""
     t0 = time.perf_counter()
     try:
         yield
@@ -203,6 +222,34 @@ def _section(name: str):
         dt = time.perf_counter() - t0
         PARTIAL.setdefault("section_s", {})[name] = round(dt, 1)
         print(f"[bench] {name}: {dt:.1f}s", file=sys.stderr, flush=True)
+        _dump_partial()
+
+
+# --only section groups -> the _section names they cover. Dependencies are
+# implicit in run(): a selected QPS group forces its family's cold section
+# (the stack it measures is built there).
+SECTION_GROUPS = (
+    "mnist_cold", "lm_cold", "flash_kernel", "chip_lm", "mnist_qps",
+    "routed", "lm_throughput", "lm_qps", "tenant_soak",
+)
+
+
+def _parse_only(spec: str | None) -> set[str] | None:
+    if not spec:
+        return None
+    sel = {s.strip() for s in spec.split(",") if s.strip()}
+    unknown = sel - set(SECTION_GROUPS)
+    if unknown:
+        raise SystemExit(
+            f"--only: unknown section(s) {sorted(unknown)}; "
+            f"valid: {', '.join(SECTION_GROUPS)}"
+        )
+    # QPS sections measure the stacks the cold sections build
+    if sel & {"mnist_qps", "routed"}:
+        sel.add("mnist_cold")
+    if sel & {"lm_throughput", "lm_qps"}:
+        sel.add("lm_cold")
+    return sel
 
 
 def _warm_buckets(runtime, mid, inputs, max_batch: int = 64) -> None:
@@ -703,6 +750,10 @@ def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dic
 
 def run(args) -> dict:
     detail = PARTIAL  # sections land here live so the watchdog can salvage
+    sel = _parse_only(args.only)
+    want = lambda name: sel is None or name in sel
+    if sel is not None:
+        detail["only"] = sorted(sel)
     platform, diag = probe_backend(args.init_timeout_s)
     detail["platform"] = platform
     detail["backend_diag"] = diag
@@ -741,125 +792,152 @@ def run(args) -> dict:
         detail["scaled_down"] = "cpu fallback: fewer tenants, tiny LM preset"
 
     # Section order = judge value per budget-second: both cold p50s feed the
-    # headline, then the flash rows, then the QPS/batcher verdicts, then the
-    # chip-sized MFU and the soak. A budget overrun now truncates the tail,
-    # not the headline (the r3 preview died mid-LM with flash/chip/soak unrun).
+    # headline, then the flash rows, then the chip-sized MFU (the single
+    # never-yet-captured hardware number, VERDICT r3 weak #4 — it must not
+    # sit behind ~10 QPS rows on a one-core host), then the QPS/batcher
+    # verdicts, then the soak. `--only` narrows to named groups so a short
+    # tunnel window can burn down exactly the unmeasured sections.
     from tfservingcache_tpu.types import ModelId
 
-    with _section("mnist_cold"):
-        cold, manager, runtime, inputs = bench_cold(
-            "mnist_cnn", args.tenants, args.batch, tmp
-        )
-    detail["mnist_cnn"] = dict(cold)
+    manager = runtime = inputs = None
+    if want("mnist_cold"):
+        with _section("mnist_cold"):
+            cold, manager, runtime, inputs = bench_cold(
+                "mnist_cnn", args.tenants, args.batch, tmp
+            )
+        detail["mnist_cnn"] = dict(cold)
 
+    lm_manager = lm_runtime = lm_inputs = None
     lm_tenants = max(4, args.tenants // 8)
     # the mnist stack (32 tiny CNNs, ~tens of MB HBM) stays resident through
     # the LM cold + flash sections — negligible vs the 16 GB chip, and worth
     # it so both headline cold p50s land before the budget can expire
-    with _section("lm_cold"):
-        lm_cold, lm_manager, lm_runtime, lm_inputs = bench_cold(
-            "transformer_lm", lm_tenants, args.lm_batch, tmp, config=lm_config
-        )
-    detail["transformer_lm"] = dict(lm_cold)
-    detail["transformer_lm"]["tenants"] = lm_tenants
-
-    try:
-        with _section("flash_kernel"):
-            detail["flash_kernel"] = bench_flash_kernel()
-    except Exception as e:  # noqa: BLE001 - kernel trouble must not sink the bench
-        detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
-
-    mnist_variants = _input_variants("mnist_cnn", args.batch, None)
-    with _section("mnist_bucket_warm"):
-        _warm_buckets(runtime, ModelId("tenant0", 1), inputs)
-    for window, key in ((0.0, "warm_rest_qps_nobatch"), (2.0, "warm_rest_qps_batch")):
-        with _section(f"mnist_{key}"):
-            qps = asyncio.run(
-                _rest_warm_qps(manager, "mnist_cnn", mnist_variants, args.warm_s,
-                               args.clients, window)
+    if want("lm_cold"):
+        with _section("lm_cold"):
+            lm_cold, lm_manager, lm_runtime, lm_inputs = bench_cold(
+                "transformer_lm", lm_tenants, args.lm_batch, tmp, config=lm_config
             )
-        detail["mnist_cnn"][key] = round(qps, 1)
-    for window, key in ((0.0, "warm_grpc_qps_nobatch"), (2.0, "warm_grpc_qps_batch")):
-        with _section(f"mnist_{key}"):
-            qps = asyncio.run(
-                _grpc_warm_qps(manager, mnist_variants, args.warm_s, args.clients,
-                               window)
-            )
-        detail["mnist_cnn"][key] = round(qps, 1)
-    manager.close()
+        detail["transformer_lm"] = dict(lm_cold)
+        detail["transformer_lm"]["tenants"] = lm_tenants
 
-    # full routed path (router -> ring -> cache node), its own node + runtime
-    try:
-        with _section("mnist_routed_qps"):
-            rqps, gqps = asyncio.run(
-                _routed_warm_qps(tmp, mnist_variants, args.warm_s, args.clients)
-            )
-        detail["mnist_cnn"]["routed_rest_qps"] = round(rqps, 1)
-        detail["mnist_cnn"]["routed_grpc_qps"] = round(gqps, 1)
-    except Exception as e:  # noqa: BLE001 - the direct rows stand on their own
-        detail["mnist_cnn"]["routed_rest_qps_error"] = f"{type(e).__name__}: {e}"
+    if want("flash_kernel"):
+        try:
+            with _section("flash_kernel"):
+                detail["flash_kernel"] = bench_flash_kernel()
+        except Exception as e:  # noqa: BLE001 - kernel trouble must not sink the bench
+            detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
 
-    # --- transformer_lm: prefill/decode + REST/gRPC/:generate ---
-    lm_variants = _input_variants("transformer_lm", args.lm_batch, lm_config)
-    with _section("lm_throughput"):
-        detail["transformer_lm"].update(
-            {
-                k: (round(v, 4) if isinstance(v, float) else v)
-                for k, v in bench_lm_throughput(
-                    lm_runtime, lm_variants, args.lm_batch, lm_config, device_kind
-                ).items()
-            }
-        )
-    # default output = last_token_logits (the out-of-box path, VERDICT r2 #4a);
-    # batcher on AND off — the on/off verdict must cover both families
-    with _section("lm_bucket_warm"):
-        _warm_buckets(lm_runtime, ModelId("tenant0", 1), lm_inputs)
-    with _section("lm_rest_qps"):
-        lm_qps = asyncio.run(
-            _rest_warm_qps(lm_manager, "transformer_lm", lm_variants, args.warm_s,
-                           args.clients, 0.0)
-        )
-    detail["transformer_lm"]["warm_rest_qps"] = round(lm_qps, 1)
-    with _section("lm_rest_qps_batch"):
-        lm_qps_b = asyncio.run(
-            _rest_warm_qps(lm_manager, "transformer_lm", lm_variants, args.warm_s,
-                           args.clients, 2.0)
-        )
-    detail["transformer_lm"]["warm_rest_qps_batch"] = round(lm_qps_b, 1)
-    with _section("lm_grpc_qps"):
-        lm_gqps = asyncio.run(
-            _grpc_warm_qps(lm_manager, lm_variants, args.warm_s, args.clients, 0.0)
-        )
-    detail["transformer_lm"]["warm_grpc_qps"] = round(lm_gqps, 1)
-    with _section("lm_generate_qps"):
-        gen_qps = asyncio.run(
-            _rest_warm_qps(lm_manager, "transformer_lm", lm_variants,
-                           args.warm_s, 8, 0.0, verb="generate", gen_tokens=16)
-        )
-    detail["transformer_lm"]["generate_qps"] = round(gen_qps, 1)
-    detail["transformer_lm"]["generate_tok_s"] = round(
-        gen_qps * args.lm_batch * 16, 1
-    )
-    lm_manager.close()
-
-    if on_tpu:
+    if want("chip_lm") and on_tpu:
         try:
             with _section("chip_lm"):
                 detail["chip_lm"] = bench_chip_model(tmp, device_kind)
         except Exception as e:  # noqa: BLE001
             detail["chip_lm"] = {"error": f"{type(e).__name__}: {e}"}
 
-    try:
-        with _section("tenant_soak"):
-            detail["tenant_soak"] = bench_tenant_soak(tmp)
-    except Exception as e:  # noqa: BLE001
-        detail["tenant_soak"] = {"error": f"{type(e).__name__}: {e}"}
+    mnist_variants = (
+        _input_variants("mnist_cnn", args.batch, None)
+        if want("mnist_qps") or want("routed") else None
+    )
+    if want("mnist_qps"):
+        with _section("mnist_bucket_warm"):
+            _warm_buckets(runtime, ModelId("tenant0", 1), inputs)
+        for window, key in ((0.0, "warm_rest_qps_nobatch"),
+                            (2.0, "warm_rest_qps_batch")):
+            with _section(f"mnist_{key}"):
+                qps = asyncio.run(
+                    _rest_warm_qps(manager, "mnist_cnn", mnist_variants,
+                                   args.warm_s, args.clients, window)
+                )
+            detail["mnist_cnn"][key] = round(qps, 1)
+        for window, key in ((0.0, "warm_grpc_qps_nobatch"),
+                            (2.0, "warm_grpc_qps_batch")):
+            with _section(f"mnist_{key}"):
+                qps = asyncio.run(
+                    _grpc_warm_qps(manager, mnist_variants, args.warm_s,
+                                   args.clients, window)
+                )
+            detail["mnist_cnn"][key] = round(qps, 1)
+    if manager is not None:
+        manager.close()
+
+    # full routed path (router -> ring -> cache node), its own node + runtime
+    if want("routed"):
+        try:
+            with _section("mnist_routed_qps"):
+                rqps, gqps = asyncio.run(
+                    _routed_warm_qps(tmp, mnist_variants, args.warm_s,
+                                     args.clients)
+                )
+            detail["mnist_cnn"]["routed_rest_qps"] = round(rqps, 1)
+            detail["mnist_cnn"]["routed_grpc_qps"] = round(gqps, 1)
+        except Exception as e:  # noqa: BLE001 - the direct rows stand on their own
+            detail["mnist_cnn"]["routed_rest_qps_error"] = f"{type(e).__name__}: {e}"
+
+    # --- transformer_lm: prefill/decode + REST/gRPC/:generate ---
+    lm_variants = (
+        _input_variants("transformer_lm", args.lm_batch, lm_config)
+        if want("lm_throughput") or want("lm_qps") else None
+    )
+    if want("lm_throughput"):
+        with _section("lm_throughput"):
+            detail["transformer_lm"].update(
+                {
+                    k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in bench_lm_throughput(
+                        lm_runtime, lm_variants, args.lm_batch, lm_config,
+                        device_kind
+                    ).items()
+                }
+            )
+    # default output = last_token_logits (the out-of-box path, VERDICT r2 #4a);
+    # batcher on AND off — the on/off verdict must cover both families
+    if want("lm_qps"):
+        with _section("lm_bucket_warm"):
+            _warm_buckets(lm_runtime, ModelId("tenant0", 1), lm_inputs)
+        with _section("lm_rest_qps"):
+            lm_qps = asyncio.run(
+                _rest_warm_qps(lm_manager, "transformer_lm", lm_variants,
+                               args.warm_s, args.clients, 0.0)
+            )
+        detail["transformer_lm"]["warm_rest_qps"] = round(lm_qps, 1)
+        with _section("lm_rest_qps_batch"):
+            lm_qps_b = asyncio.run(
+                _rest_warm_qps(lm_manager, "transformer_lm", lm_variants,
+                               args.warm_s, args.clients, 2.0)
+            )
+        detail["transformer_lm"]["warm_rest_qps_batch"] = round(lm_qps_b, 1)
+        with _section("lm_grpc_qps"):
+            lm_gqps = asyncio.run(
+                _grpc_warm_qps(lm_manager, lm_variants, args.warm_s,
+                               args.clients, 0.0)
+            )
+        detail["transformer_lm"]["warm_grpc_qps"] = round(lm_gqps, 1)
+        with _section("lm_generate_qps"):
+            gen_qps = asyncio.run(
+                _rest_warm_qps(lm_manager, "transformer_lm", lm_variants,
+                               args.warm_s, 8, 0.0, verb="generate",
+                               gen_tokens=16)
+            )
+        detail["transformer_lm"]["generate_qps"] = round(gen_qps, 1)
+        detail["transformer_lm"]["generate_tok_s"] = round(
+            gen_qps * args.lm_batch * 16, 1
+        )
+    if lm_manager is not None:
+        lm_manager.close()
+
+    if want("tenant_soak"):
+        try:
+            with _section("tenant_soak"):
+                detail["tenant_soak"] = bench_tenant_soak(tmp)
+        except Exception as e:  # noqa: BLE001
+            detail["tenant_soak"] = {"error": f"{type(e).__name__}: {e}"}
 
     for fam in ("mnist_cnn", "transformer_lm"):
-        detail[fam] = {
-            k: (round(v, 4) if isinstance(v, float) else v)
-            for k, v in detail[fam].items()
-        }
+        if fam in detail:
+            detail[fam] = {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in detail[fam].items()
+            }
     return detail
 
 
@@ -873,6 +951,11 @@ def main() -> int:
     parser.add_argument("--target-s", type=float, default=TARGET_S)
     parser.add_argument("--init-timeout-s", type=float, default=240.0)
     parser.add_argument("--budget-s", type=float, default=2100.0)
+    parser.add_argument(
+        "--only", default=os.environ.get("TPUSC_BENCH_ONLY", ""),
+        help=f"comma-separated section groups ({', '.join(SECTION_GROUPS)}); "
+             "QPS groups pull in their family's cold section",
+    )
     args = parser.parse_args()
 
     def watchdog() -> None:
@@ -926,22 +1009,41 @@ def main() -> int:
         p50s = {
             fam: detail[fam]["cold_p50_s"]
             for fam in ("mnist_cnn", "transformer_lm")
+            if isinstance(detail.get(fam), dict) and "cold_p50_s" in detail[fam]
         }
-        worst_fam = max(p50s, key=p50s.get)
-        p50 = p50s[worst_fam]
         on_tpu = detail["platform"] != "cpu"
         # a CPU-fallback run (tunnel down) proves the harness, not the perf:
         # its tiny presets against a TPU-hardware target would fabricate a
         # huge vs_baseline — report 0.0 (not comparable) instead
         tag = "" if on_tpu else " [CPU FALLBACK — vs_baseline not comparable]"
+        if not p50s:
+            # --only run without a cold section: the sections carry the value
+            emit(
+                {
+                    "metric": (
+                        f"bench sections {detail.get('only', [])} "
+                        f"({detail['platform']}){tag}"
+                    ),
+                    "value": None,
+                    "unit": "s",
+                    "vs_baseline": 0.0,
+                    "detail": detail,
+                }
+            )
+            return 0
+        worst_fam = max(p50s, key=p50s.get)
+        p50 = p50s[worst_fam]
+        fam_bits = "; ".join(
+            f"{'mnist' if fam == 'mnist_cnn' else 'lm'} {v:.2f}s"
+            for fam, v in p50s.items()
+        )
         emit(
             {
                 "metric": (
                     f"cold_miss_load_to_first_predict_p50 (worst family: "
-                    f"{worst_fam}, {detail['platform']}; mnist "
-                    f"{p50s['mnist_cnn']:.2f}s / lm {p50s['transformer_lm']:.2f}s; "
-                    f"lm REST {detail['transformer_lm'].get('warm_rest_qps', 0):.0f} qps "
-                    f"gRPC {detail['transformer_lm'].get('warm_grpc_qps', 0):.0f} qps)"
+                    f"{worst_fam}, {detail['platform']}; {fam_bits}; "
+                    f"lm REST {detail.get('transformer_lm', {}).get('warm_rest_qps', 0):.0f} qps "
+                    f"gRPC {detail.get('transformer_lm', {}).get('warm_grpc_qps', 0):.0f} qps)"
                     f"{tag}"
                 ),
                 "value": round(p50, 4),

@@ -449,3 +449,34 @@ def test_loads_request_fuzz_parity_with_json_loads():
         got = norm(loads_request(body))
         want = json.loads(body)
         np.testing.assert_equal(got, want)
+
+
+def test_int64_overflow_parity_native_vs_fallback():
+    """Integral tokens outside int64 must NOT demote the tensor to float64
+    in the native parser (precision loss + divergence from json.loads,
+    ADVICE r3): both paths must yield the same exact values."""
+    from tfservingcache_tpu.protocol.codec import loads_request
+
+    big = (1 << 63) + 3  # in [2^63, 2^64): exact as uint64, not as float64
+    body = json.dumps({"inputs": [[big, 1], [2, 3]]}).encode()
+    parsed = loads_request(body)
+    ref = json.loads(body)
+    vals = parsed["inputs"]
+    if isinstance(vals, np.ndarray):
+        assert vals.tolist() == ref["inputs"]
+    else:
+        assert vals == ref["inputs"]
+    # a homogeneous over-int64 array stays exact via the fallback ints
+    only_big = loads_request(json.dumps({"x": [big, big + 1]}).encode())["x"]
+    assert np.asarray(only_big).dtype == np.uint64
+    assert np.asarray(only_big).tolist() == [big, big + 1]
+
+
+def test_over_uint64_int_is_codec_error_not_500():
+    """Ints beyond uint64 raise OverflowError inside np.asarray — the codec
+    must surface CodecError (-> client 400), not an unhandled 500."""
+    from tfservingcache_tpu.protocol.codec import CodecError, decode_predict_json
+
+    body = {"inputs": [int(1 << 70), 1]}
+    with pytest.raises(CodecError):
+        decode_predict_json(body)

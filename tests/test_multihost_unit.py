@@ -142,3 +142,59 @@ async def test_leader_broadcast_surfaces_follower_error_detail():
             leader.close()
     finally:
         await srv.close()
+
+
+async def test_follower_timeout_tied_to_request_deadline():
+    """A slow follower must fail the leader's op within ~the configured
+    request deadline (serving.load_timeout_s), not the flat work_timeout_s
+    backstop — a 504'd request must not pin the group lock for minutes
+    (VERDICT r3 next #7)."""
+    import time as _time
+
+    class _SlowManager(_RecordingManager):
+        def prefetch(self, mid):
+            _time.sleep(10.0)
+
+    handler = GroupWorkHandler()
+    handler.register(0, _SlowManager(), _RecordingRuntime())
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    try:
+        leader = MultiHostGroupRuntime(
+            ServingConfig(platform="cpu", load_timeout_s=0.5),
+            followers=[f"127.0.0.1:{port}"],
+            group_index=0,
+        )
+        assert leader._op_timeout_s == 0.5  # min(work 600, load 0.5)
+        try:
+            t0 = _time.monotonic()
+            futures = leader._broadcast(
+                {"op": "prefetch", "model": "m", "version": 1}
+            )
+            with pytest.raises(RuntimeError, match="follower"):
+                await asyncio.get_running_loop().run_in_executor(
+                    None, leader._join, futures
+                )
+            assert _time.monotonic() - t0 < 5.0  # bound ~deadline, not 600 s
+        finally:
+            leader.close()
+    finally:
+        await srv.close()
+
+
+async def test_follower_drops_expired_queued_work():
+    """An item whose budget elapsed while queued behind the follower's group
+    lock fails fast instead of replaying an op the leader abandoned."""
+    handler = GroupWorkHandler()
+    handler.register(0, _RecordingManager(), _RecordingRuntime())
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    try:
+        status, out = await _post(
+            port,
+            {"op": "ensure", "model": "m", "version": 1, "group": 0,
+             "budget_s": 0.0},
+        )
+        assert status == 500 and "expired" in out["error"]
+    finally:
+        await srv.close()

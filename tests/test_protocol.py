@@ -68,7 +68,8 @@ class EchoBackend(ServingBackend):
     async def reload_config(self, request):
         return sv.ReloadConfigResponse()
 
-    async def handle_rest(self, method, model_name, version, verb, body):
+    async def handle_rest(self, method, model_name, version, verb, body,
+                          label=None):
         if model_name == "boom":
             raise BackendError("kaput", grpc.StatusCode.NOT_FOUND, 404)
         payload = {
@@ -76,6 +77,7 @@ class EchoBackend(ServingBackend):
             "model": model_name,
             "version": version,
             "verb": verb,
+            "label": label,
             "body_len": len(body),
         }
         return RestResponse(status=200, body=json.dumps(payload).encode())
@@ -97,15 +99,23 @@ async def serving_servers():
 
 
 def test_parse_model_url_rules():
-    assert parse_model_url("/v1/models/m/versions/3:predict") == ("m", 3, "predict")
-    assert parse_model_url("/v1/models/m:predict") == ("m", None, "predict")
-    assert parse_model_url("/v1/models/m/versions/3") == ("m", 3, None)
-    assert parse_model_url("/v1/models/m") == ("m", None, None)
-    assert parse_model_url("/V1/MODELS/m/VERSIONS/3") == ("m", 3, None)  # case-insensitive
-    assert parse_model_url("/v1/models/m/versions/3/metadata") == ("m", 3, "metadata")
+    assert parse_model_url("/v1/models/m/versions/3:predict") == ("m", 3, "predict", None)
+    assert parse_model_url("/v1/models/m:predict") == ("m", None, "predict", None)
+    assert parse_model_url("/v1/models/m/versions/3") == ("m", 3, None, None)
+    assert parse_model_url("/v1/models/m") == ("m", None, None, None)
+    assert parse_model_url("/V1/MODELS/m/VERSIONS/3") == ("m", 3, None, None)  # case-insensitive
+    assert parse_model_url("/v1/models/m/versions/3/metadata") == ("m", 3, "metadata", None)
     assert parse_model_url("/v2/nope") is None
     assert parse_model_url("/v1/models/m:poke") is None
     assert parse_model_url("/v1/models/m/versions/notanumber") is None
+    # TF Serving's /labels/ alternative (resolved via serving.version_labels)
+    assert parse_model_url("/v1/models/m/labels/stable:predict") == (
+        "m", None, "predict", "stable"
+    )
+    assert parse_model_url("/v1/models/m/labels/canary") == ("m", None, None, "canary")
+    assert parse_model_url("/v1/models/m/labels/stable/metadata") == (
+        "m", None, "metadata", "stable"
+    )
 
 
 async def test_rest_predict_roundtrip():
@@ -120,6 +130,7 @@ async def test_rest_predict_roundtrip():
             "model": "mymodel",
             "version": 2,
             "verb": "predict",
+            "label": None,
             "body_len": 18,
         }
 

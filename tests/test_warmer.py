@@ -92,3 +92,34 @@ def test_rewarm_after_remap(tmp_path):
         assert runtime.is_loaded(ModelId("a", 1))
     finally:
         w.close()
+
+
+def test_sweep_bounded_by_resident_capacity(tmp_path):
+    """A node owning more cached models than fit resident must NOT cycle the
+    HBM LRU warming them all — the sweep stops at capacity so it never
+    evicts actively-serving models or its own earlier warms (ADVICE r3)."""
+    provider = make_store(
+        tmp_path / "store", [("a", 1, 10), ("b", 1, 10), ("c", 1, 10)]
+    )
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1000)
+    runtime = FakeRuntime(max_loaded=2)
+    manager = CacheManager(provider, cache, runtime)
+    # "live" is actively serving and must survive the sweep
+    manager.prefetch(ModelId("a", 1))
+    manager.ensure_servable(ModelId("a", 1))
+    for name in ("b", "c"):
+        manager.prefetch(ModelId(name, 1))
+    self_id = ident(7001)
+    ring = RingStub({k: [7001] for k in ("a##1", "b##1", "c##1")})
+    w = AssignmentWarmer(ring, [(self_id, manager)])
+    try:
+        w.on_update([])
+        # one free slot: exactly one additional warm happens, then the sweep
+        # stops — nothing is evicted
+        assert wait_for(lambda: len(runtime.loads) == 2)
+        time.sleep(0.1)  # give an over-warm a chance to happen
+        assert len(runtime.loads) == 2
+        assert runtime.unloads == []
+        assert runtime.is_loaded(ModelId("a", 1))
+    finally:
+        w.close()

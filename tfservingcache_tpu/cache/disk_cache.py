@@ -112,6 +112,12 @@ class ModelDiskCache:
     def list_models(self) -> list[ModelId]:
         return self.lru.keys_mru_first()
 
+    def size_of(self, model_id: ModelId) -> int | None:
+        """On-disk artifact bytes (None if absent) — the warmer's estimate
+        of a model's HBM footprint before paying to load it."""
+        model = self.lru.get(model_id, touch=False)
+        return None if model is None else model.size_on_disk
+
     @property
     def total_bytes(self) -> int:
         return self.lru.total_bytes

@@ -68,6 +68,8 @@ class AssignmentWarmer:
 
     def _sweep(self, gen: int) -> None:
         for ident, manager in self.groups:
+            # list is MRU-first, so capacity (below) is spent on the models
+            # most likely to be asked for first
             for mid in manager.disk_cache.list_models():
                 if self._stop or self._generation != gen:
                     return  # newer membership: restart against it
@@ -83,6 +85,25 @@ class AssignmentWarmer:
                 # acceptable: warming is advisory)
                 if manager.disk_cache.get(mid) is None:
                     continue
+                # bound the sweep by free resident capacity: when a node
+                # owns more cached models than fit in HBM (the multi-tenant
+                # norm), warming past the cap would evict actively-serving
+                # models — and this sweep's own earlier warms — churning
+                # live traffic right after a remap (ADVICE r3 medium)
+                runtime = getattr(manager, "runtime", None)
+                headroom = getattr(runtime, "resident_headroom", None)
+                if headroom is not None and not runtime.is_loaded(mid):
+                    free_slots, free_bytes = headroom()
+                    est = manager.disk_cache.size_of(mid) or 0
+                    if (free_slots is not None and free_slots <= 0) or (
+                        est > free_bytes
+                    ):
+                        log.info(
+                            "warm sweep for %s stopped at resident capacity "
+                            "(%s slots free, %d bytes free, next needs ~%d)",
+                            ident, free_slots, free_bytes, est,
+                        )
+                        break  # MRU-first: everything after is colder
                 try:
                     manager.ensure_servable(mid)
                     self.warmed += 1
@@ -101,3 +122,11 @@ class AssignmentWarmer:
         self._generation += 1  # abort the sweep at its next model boundary
         self._wake.set()
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # an in-flight cold load outlived the join budget: teardown
+            # proceeds, so name the race loudly instead of letting the
+            # straggler fail silently against a closing backend (ADVICE r3)
+            log.warning(
+                "warmer thread still mid-load at close; it exits at the next "
+                "model boundary and its errors are swallowed"
+            )

@@ -768,7 +768,12 @@ struct Parser {
             int64_t iv;
             auto ri = std::from_chars(s + tok_start, s + i, iv);
             if (ri.ec != std::errc() || ri.ptr != s + i) {
-              t->is_int = false;  // out of int64 range: fall to float
+              // integral but outside int64: a float64 demotion would lose
+              // precision AND diverge from the json.loads fallback, which
+              // yields exact Python ints (np.asarray gives an exact uint64
+              // array for [2^63, 2^64)) — decline extraction so the whole
+              // array takes the verbatim/fallback path (ADVICE r3)
+              return false;
             } else {
               t->ivals.push_back(iv);
             }

@@ -77,4 +77,5 @@ class ServingBackend(abc.ABC):
         version: int | None,
         verb: str | None,
         body: bytes,
+        label: str | None = None,
     ) -> RestResponse: ...

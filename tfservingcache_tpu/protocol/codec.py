@@ -219,7 +219,9 @@ def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
         # ~16 ms per 6k-element request, the REST hot path's dominant cost
         try:
             arr = np.asarray(value)
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, OverflowError):
+            # OverflowError: ints beyond uint64 — a malformed body must drop
+            # to the slow path's 400, not become a 500 (ADVICE r3)
             arr = np.empty(0, object)  # ragged/mixed: take the slow path
         if arr.dtype.kind in "fiub":
             if dtype is not None:
@@ -238,7 +240,10 @@ def _value_to_array(value: Any, dtype: np.dtype | None) -> np.ndarray:
 
     if has_bytes(value):
         return np.array(value, dtype=object)
-    arr = np.asarray(value)
+    try:
+        arr = np.asarray(value)
+    except OverflowError as e:
+        raise CodecError(f"integer input exceeds uint64 range: {e}") from e
     if arr.dtype == object:
         # mixed/ragged JSON (e.g. binary specs inconsistently nested in
         # rows) must surface as the client's 400, not a 500 downstream

@@ -33,8 +33,15 @@ from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("rest")
 
-# reference regex, tfservingproxy.go:24
-URL_RE = re.compile(r"^/v1/models/(?P<name>[^/]+?)(/versions/(?P<version>[0-9]+))?$", re.I)
+# reference regex (tfservingproxy.go:24) extended with the /labels/<label>
+# alternative TF Serving's own REST API accepts — the reference proxies the
+# URL through verbatim and TF Serving resolves the label, so label parity
+# needs first-class parsing here
+URL_RE = re.compile(
+    r"^/v1/models/(?P<name>[^/]+?)"
+    r"(/versions/(?P<version>[0-9]+)|/labels/(?P<label>[^/]+?))?$",
+    re.I,
+)
 
 # "generate" is a tpusc extension verb (KV-cached autoregressive decoding);
 # the reference protocol verbs are predict/classify/regress
@@ -46,11 +53,15 @@ def _error_body(message: str) -> bytes:
     return json.dumps({"Status": "Error", "Message": message}).encode()
 
 
-def parse_model_url(path: str) -> tuple[str, int | None, str | None] | None:
-    """-> (model_name, version|None, verb|None), or None when unroutable.
+def parse_model_url(
+    path: str,
+) -> tuple[str, int | None, str | None, str | None] | None:
+    """-> (model_name, version|None, verb|None, label|None), or None when
+    unroutable.
 
     ``verb`` is ``predict``/``classify``/``regress``/``metadata`` or None
-    (bare GET = status probe).
+    (bare GET = status probe). ``version`` and ``label`` are mutually
+    exclusive by the URL grammar.
     """
     verb: str | None = None
     if ":" in path:
@@ -65,7 +76,12 @@ def parse_model_url(path: str) -> tuple[str, int | None, str | None] | None:
     if not m:
         return None
     version = m.group("version")
-    return m.group("name"), (int(version) if version is not None else None), verb
+    return (
+        m.group("name"),
+        (int(version) if version is not None else None),
+        verb,
+        m.group("label"),
+    )
 
 
 class RestServingServer:
@@ -126,8 +142,8 @@ class RestServingServer:
             return self._fail(web.Response(
                 status=404, body=_error_body("Not found"), content_type="application/json"
             ))
-        name, version, verb = parsed
-        if version is None and self.require_version:
+        name, version, verb, label = parsed
+        if version is None and label is None and self.require_version:
             return self._fail(web.Response(
                 status=400,
                 body=_error_body("Model version must be provided"),
@@ -137,7 +153,7 @@ class RestServingServer:
         try:
             with TRACER.span("rest", path=path, method=request.method):
                 resp: RestResponse = await self.backend.handle_rest(
-                    request.method, name, version, verb, body
+                    request.method, name, version, verb, body, label=label
                 )
         except BackendError as e:
             return self._fail(web.Response(

@@ -73,6 +73,16 @@ class FakeRuntime(BaseRuntime):
         with self._lock:
             return model_id in self._loaded
 
+    def resident_headroom(self) -> tuple[int | None, int]:
+        # mirrors TPUModelRuntime.resident_headroom (byte budget uncapped
+        # here: the fake sizes nothing)
+        with self._lock:
+            free = (
+                None if self.max_loaded is None
+                else max(0, self.max_loaded - len(self._loaded))
+            )
+        return free, 1 << 60
+
     def predict(
         self,
         model_id: ModelId,

@@ -568,6 +568,19 @@ class TPUModelRuntime(BaseRuntime):
     def is_loaded(self, model_id: ModelId) -> bool:
         return self._resident.get(model_id, touch=False) is not None
 
+    def resident_headroom(self) -> tuple[int | None, int]:
+        """(free resident model slots or None if uncapped, free HBM bytes).
+        Advisory snapshot for the assignment warmer: warming past this would
+        evict actively-serving models (ADVICE r3: a post-remap sweep must
+        help live traffic, not churn it)."""
+        free_slots = (
+            None if self._resident.max_items is None
+            else max(0, self._resident.max_items - len(self._resident))
+        )
+        return free_slots, max(
+            0, self.cfg.hbm_capacity_bytes - self._resident.total_bytes
+        )
+
     def family_of(self, model_id: ModelId) -> str | None:
         """Family of a resident model (None when not loaded) — the generate
         coalescer keys on this: capacity-routed families (moe_lm) must not

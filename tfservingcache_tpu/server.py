@@ -126,6 +126,7 @@ class CacheNode:
                         mgr = CacheManager(
                             provider, disk_cache, rt, self.metrics,
                             load_timeout_s=cfg.serving.load_timeout_s,
+                            version_labels=cfg.serving.version_labels,
                         )
                         self.work_handler.register(gi, mgr, rt)
                         self._follower_managers.append(mgr)
@@ -138,6 +139,7 @@ class CacheNode:
             manager = CacheManager(
                 provider, disk_cache, rt, self.metrics,
                 load_timeout_s=cfg.serving.load_timeout_s,
+                version_labels=cfg.serving.version_labels,
             )
             backend = LocalServingBackend(
                 manager,

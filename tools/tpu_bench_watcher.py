@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Burn down the not-yet-measured TPU bench sections whenever the tunnel answers.
+
+The TPU behind this harness is reachable through a tunnel that goes down for
+hours at a time (ROUND3_NOTES; BENCH_r03 was a CPU fallback because of it).
+This watcher loops forever: probe the backend in a child process with a
+timeout; when it answers, run the highest-priority PENDING measurement unit
+as its own ``bench.py --only ...`` invocation (or the kernel check), record
+the JSON artifact under ``tpu_runs/``, and commit it. A 30-minute tunnel
+window therefore yields the most valuable unmeasured rows first (chip-sized
+MFU, flash magnitudes, LM cold p50) instead of a fourth copy of mnist QPS —
+VERDICT r3 next-round #2.
+
+State lives in ``tpu_runs/state.json`` so a restarted watcher (or a fresh
+round) resumes the burn-down instead of starting over. A unit only counts as
+done if its output proves it ran on TPU (``platform != "cpu"`` /
+pytest rc == 0 for the kernel check).
+
+Usage:  nohup python tools/tpu_bench_watcher.py >> tpu_runs/watcher.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNS = os.path.join(REPO, "tpu_runs")
+STATE = os.path.join(RUNS, "state.json")
+PROBE_TIMEOUT_S = 120.0
+SLEEP_DOWN_S = 180.0
+
+# Priority = judge value of the still-missing evidence (VERDICT r3 #1):
+# the chip-sized MFU has never been captured on hardware, then the flash
+# magnitudes + both cold p50s (the headline), then the batcher on/off
+# verdict, then the routed/soak tail, then one canonical full run.
+UNITS: list[tuple[str, list[str], float]] = [
+    ("kernel_check", ["tools/tpu_kernel_check.py"], 1200.0),
+    ("chip_lm", ["bench.py", "--only", "chip_lm"], 1500.0),
+    ("cold_flash", ["bench.py", "--only", "mnist_cold,lm_cold,flash_kernel"],
+     1500.0),
+    ("batcher_qps", ["bench.py", "--only", "mnist_qps,lm_qps,lm_throughput"],
+     1800.0),
+    ("routed_soak", ["bench.py", "--only", "routed,tenant_soak"], 1200.0),
+    ("full", ["bench.py"], 2100.0),
+]
+
+
+def log(msg: str) -> None:
+    print(f"[watcher {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(state: dict) -> None:
+    os.makedirs(RUNS, exist_ok=True)
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, STATE)
+
+
+def probe() -> bool:
+    code = (
+        "import jax, json; d = jax.devices();"
+        "import jax.numpy as jnp;"
+        "x = (jnp.ones((256,256)) @ jnp.ones((256,256))).block_until_ready();"
+        "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=PROBE_TIMEOUT_S, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode != 0 or not r.stdout.strip():
+        return False
+    try:
+        info = json.loads(r.stdout.strip().splitlines()[-1])
+    except ValueError:
+        return False
+    return info.get("platform") not in (None, "cpu")
+
+
+def commit(paths: list[str], msg: str) -> None:
+    """Commit just these artifact paths; never sweep concurrent work in."""
+    try:
+        subprocess.run(["git", "add", "--", *paths], cwd=REPO, timeout=60,
+                       capture_output=True)
+        subprocess.run(
+            ["git", "commit", "--only", "-m", msg, "--", *paths],
+            cwd=REPO, timeout=60, capture_output=True,
+        )
+    except Exception as e:  # noqa: BLE001 - an index-lock race just retries later
+        log(f"commit skipped: {e}")
+
+
+def _has(d: dict, *path) -> bool:
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return False
+        d = d[p]
+    return not (isinstance(d, dict) and "error" in d)
+
+
+def unit_ok(name: str, payload: dict) -> bool:
+    """Did this bench run actually CAPTURE the numbers the unit exists for?
+    platform != cpu alone is not enough: a section that errored on hardware
+    (detail["chip_lm"] = {"error": ...}) must stay pending and retry."""
+    detail = payload.get("detail", {})
+    if detail.get("platform") in (None, "cpu"):
+        return False
+    need = {
+        "chip_lm": [("chip_lm", "prefill_ms")],
+        "cold_flash": [
+            ("mnist_cnn", "cold_p50_s"),
+            ("transformer_lm", "cold_p50_s"),
+            ("flash_kernel", "bench_shape", "speedup"),
+        ],
+        "batcher_qps": [
+            ("mnist_cnn", "warm_rest_qps_nobatch"),
+            ("mnist_cnn", "warm_grpc_qps_batch"),
+            ("transformer_lm", "warm_rest_qps"),
+            ("transformer_lm", "warm_rest_qps_batch"),
+        ],
+        "routed_soak": [
+            ("mnist_cnn", "routed_rest_qps"),
+            ("tenant_soak", "hbm_hit_rate"),
+        ],
+        "full": [
+            ("mnist_cnn", "cold_p50_s"),
+            ("transformer_lm", "cold_p50_s"),
+        ],
+    }.get(name, [])
+    return all(_has(detail, *path) for path in need)
+
+
+def salvage_partial(name: str, partial_path: str) -> None:
+    """A wedged/timed-out run still flushed finished sections to its partial
+    file — commit that evidence instead of re-measuring it from scratch."""
+    if not os.path.exists(partial_path):
+        return
+    dst = os.path.join(RUNS, f"{name}.salvage.json")
+    try:
+        with open(partial_path) as f:
+            content = f.read()
+        json.loads(content)  # only salvage parseable partials
+        with open(dst, "w") as f:
+            f.write(content)
+        commit([dst], f"TPU watcher: salvaged partial sections from {name}")
+        log(f"salvaged partial for {name} -> {dst}")
+    except (OSError, ValueError) as e:
+        log(f"partial salvage for {name} failed: {e}")
+
+
+def run_unit(name: str, argv: list[str], budget_s: float) -> bool:
+    os.makedirs(RUNS, exist_ok=True)
+    out_path = os.path.join(RUNS, f"{name}.json")
+    log_path = os.path.join(RUNS, f"{name}.log")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    partial_path = os.path.join(RUNS, f"{name}.partial.json")
+    env["TPUSC_BENCH_PARTIAL"] = partial_path
+    is_bench = argv[0] == "bench.py"
+    cmd = [sys.executable, *argv]
+    if is_bench:
+        cmd += ["--init-timeout-s", "150", "--budget-s", str(budget_s)]
+    log(f"running unit {name}: {' '.join(cmd)}")
+    try:
+        with open(log_path, "a") as lf:
+            r = subprocess.run(
+                cmd, cwd=REPO, env=env, timeout=budget_s + 300,
+                stdout=subprocess.PIPE, stderr=lf, text=True,
+            )
+    except subprocess.TimeoutExpired:
+        log(f"unit {name} timed out")
+        if is_bench:
+            salvage_partial(name, partial_path)
+        return False
+    stdout = r.stdout or ""
+    with open(log_path, "a") as lf:
+        lf.write(stdout)
+    if not is_bench:  # kernel check: pytest rc carries the verdict
+        with open(out_path, "w") as f:
+            f.write(stdout)
+        ok = r.returncode == 0 and "[kernel]" in stdout
+        if ok:
+            kc = os.path.join(REPO, "KERNEL_CHECK_r04.txt")
+            with open(kc, "w") as f:
+                f.write(stdout)
+            commit([out_path, kc], "TPU watcher: kernel check with magnitudes")
+        return ok
+    line = next(
+        (ln for ln in stdout.splitlines() if ln.startswith("{")), None
+    )
+    if line is None:
+        log(f"unit {name}: no JSON line (rc={r.returncode})")
+        salvage_partial(name, partial_path)
+        return False
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+    try:
+        payload = json.loads(line)
+    except ValueError:
+        payload = {}
+    ok = unit_ok(name, payload)
+    on_tpu = payload.get("detail", {}).get("platform") not in (None, "cpu")
+    if on_tpu:
+        # hardware rows are evidence even when the unit's target section
+        # errored (ok=False -> retried later)
+        commit([out_path], f"TPU watcher: {name} on hardware"
+                           + ("" if ok else " (target section incomplete)"))
+    else:
+        log(f"unit {name} fell back to cpu; keeping pending")
+    return ok
+
+
+def main() -> int:
+    state = load_state()
+    # seed from persisted state: a restarted watcher must keep preferring
+    # never-attempted units over known-failing ones
+    fails: dict[str, int] = {
+        u: s.get("fails", 0) for u, s in state.items() if s.get("fails")
+    }
+    log(f"starting; done units: {[u for u, s in state.items() if s.get('done')]}")
+    while True:
+        pending = [u for u in UNITS if not state.get(u[0], {}).get("done")]
+        if not pending:
+            log("all units measured on TPU; idling (re-run to re-measure)")
+            time.sleep(3600)
+            continue
+        if not probe():
+            log(f"tunnel down; {len(pending)} units pending; "
+                f"sleeping {SLEEP_DOWN_S:.0f}s")
+            time.sleep(SLEEP_DOWN_S)
+            continue
+        # fewest-failures-first (ties keep priority order): a deterministic
+        # failure in the top unit must not starve the never-attempted ones
+        name, argv, budget = min(
+            pending, key=lambda u: fails.get(u[0], 0)
+        )
+        ok = run_unit(name, argv, budget)
+        state.setdefault(name, {})["done"] = ok
+        state[name]["at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        if not ok:
+            fails[name] = fails.get(name, 0) + 1
+            state[name]["fails"] = fails[name]
+        save_state(state)
+        log(f"unit {name}: {'DONE' if ok else 'still pending'}")
+        if not ok:
+            time.sleep(60)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
