@@ -182,19 +182,31 @@ async def test_follower_timeout_tied_to_request_deadline():
         await srv.close()
 
 
-async def test_follower_drops_expired_queued_work():
-    """An item whose budget elapsed while queued behind the follower's group
-    lock fails fast instead of replaying an op the leader abandoned."""
+async def test_follower_drops_expired_queued_prefetch_only():
+    """A PREFETCH whose budget elapsed while queued fails fast (the leader
+    abandoned it), but collective ops must run however late — the leader has
+    already entered its half of the program, so skipping one would wedge the
+    group's collective forever."""
     handler = GroupWorkHandler()
-    handler.register(0, _RecordingManager(), _RecordingRuntime())
+    mgr, rt = _RecordingManager(), _RecordingRuntime()
+    handler.register(0, mgr, rt)
     srv = GroupWorkServer(handler)
     port = await srv.start(0, host="127.0.0.1")
     try:
         status, out = await _post(
             port,
-            {"op": "ensure", "model": "m", "version": 1, "group": 0,
+            {"op": "prefetch", "model": "m", "version": 1, "group": 0,
              "budget_s": 0.0},
         )
         assert status == 500 and "expired" in out["error"]
+        assert ("prefetch", ModelId("m", 1)) not in mgr.calls
+        # expired COLLECTIVE op still executes
+        status, out = await _post(
+            port,
+            {"op": "ensure", "model": "m", "version": 1, "group": 0,
+             "budget_s": 0.0},
+        )
+        assert status == 200 and out["ok"]
+        assert ("ensure", ModelId("m", 1)) in mgr.calls
     finally:
         await srv.close()

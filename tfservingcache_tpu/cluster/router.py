@@ -21,6 +21,10 @@ from typing import Mapping
 import aiohttp
 import grpc
 
+from tfservingcache_tpu.cache.manager import (
+    VersionLabelError,
+    resolve_version_label,
+)
 from tfservingcache_tpu.cluster.cluster import ClusterConnection
 from tfservingcache_tpu.cluster.discovery import create_discovery
 from tfservingcache_tpu.config import Config
@@ -103,11 +107,6 @@ class RoutingBackend(ServingBackend):
         cluster.on_update.append(self.pool.prune)
 
     def _resolve_label(self, name: str, label: str) -> int:
-        from tfservingcache_tpu.cache.manager import (
-            VersionLabelError,
-            resolve_version_label,
-        )
-
         try:
             return resolve_version_label(self.version_labels, name, label)
         except VersionLabelError as e:

@@ -313,6 +313,167 @@ def flash_attention(
     return out
 
 
+def _flash_carry_kernel(
+    rel_ref, q_ref, k_ref, v_ref, acc_in_ref, m_in_ref, l_in_ref,
+    acc_out_ref, m_out_ref, l_out_ref, acc_s, m_s, l_s, *,
+    sm_scale: float, causal: bool, block_q: int, block_k: int, num_k: int,
+):
+    """Streamed flash step that THREADS the online-softmax carry: scratch is
+    seeded from (acc_in, m_in, l_in) at kj==0 and written back at the last
+    kj, so a caller can chain calls over K/V blocks that arrive one at a
+    time — ring attention's ppermute hops (parallel/ring_attention.py).
+    ``rel_ref`` (SMEM) holds k_off - q_off: global positions are runtime
+    values under shard_map (axis_index), never compile-time constants."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    rel = rel_ref[0]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_s[...] = acc_in_ref[0]
+        m_s[...] = jnp.broadcast_to(m_in_ref[0], m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_in_ref[0], l_s.shape)
+
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            iq = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            ik = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + kj * block_k
+            s = jnp.where(iq - ik >= rel, s, NEG_INF)
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # guard the all-masked case: with m_new still NEG_INF,
+        # exp(NEG_INF - NEG_INF) would be 1 and corrupt l/acc — a fully
+        # masked future block must be a strict no-op on the carry
+        p = jnp.where(s > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_s[...] = acc_s[...] * alpha + pv
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    if causal:
+        # skip blocks wholly above the causal frontier (rel is traced, so
+        # the bound is a runtime predicate, not a shorter grid)
+        pl.when(qi * block_q + block_q - 1 - kj * block_k >= rel)(_body)
+    else:
+        _body()
+
+    @pl.when(kj == num_k - 1)
+    def _final():
+        acc_out_ref[0] = acc_s[...]
+        m_out_ref[0] = m_s[:, :1]
+        l_out_ref[0] = l_s[:, :1]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention_carry(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    acc: jax.Array,
+    m: jax.Array,
+    l: jax.Array,
+    rel: jax.Array,
+    causal: bool = True,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One flash pass of local Q against ONE K/V block with carried
+    online-softmax state — the ring-attention inner step, score matrix never
+    materialized. Shapes: q/k/v (B, H, Sq|Sk, D) (Hkv may divide H);
+    acc (B, H, Sq, D) f32; m/l (B, H, Sq, 1) f32; ``rel`` scalar int32 =
+    k_off - q_off in global positions. Sq/Sk must be multiples of 128 (ring
+    shards are; no padding path here). Returns updated (acc, m, l);
+    normalize ``acc / max(l, eps)`` after the last block."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    if sq % 128 or sk % 128:
+        raise ValueError(f"carry kernel needs 128-multiple seq, got {sq}/{sk}")
+    g = h // hkv
+    if block_q is None:
+        block_q = 256 if sq % 256 == 0 else 128
+    if block_k is None:
+        block_k = next(bk for bk in (512, 256, 128) if sk % bk == 0)
+    sm_scale = 1.0 / math.sqrt(d)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hkv, sk, d)
+    vf = v.reshape(b * hkv, sk, d)
+    accf = acc.reshape(b * h, sq, d)
+    mf = m.reshape(b * h, sq, 1)
+    lf = l.reshape(b * h, sq, 1)
+    rel_arr = jnp.asarray(rel, jnp.int32).reshape((1,))
+
+    num_k = sk // block_k
+    kernel = functools.partial(
+        _flash_carry_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k,
+    )
+    grid = (b * h, sq // block_q, num_k)
+    kv_index = lambda i, j, kj: (i // h * hkv + (i % h) // g, kj, 0)
+    q_index = lambda i, j, kj: (i, j, 0)
+    stat_spec = pl.BlockSpec((1, block_q, 1), q_index)
+    acc_o, m_o, l_o = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),       # rel
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_q, d), q_index),      # acc in
+            stat_spec,                                   # m in
+            stat_spec,                                   # l in
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            stat_spec,
+            stat_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+    )(rel_arr, qf, kf, vf, accf, mf, lf)
+    return (
+        acc_o.reshape(b, h, sq, d),
+        m_o.reshape(b, h, sq, 1),
+        l_o.reshape(b, h, sq, 1),
+    )
+
+
 TPU_BACKENDS = ("tpu", "axon")  # axon = tunneled TPU plugin in this image
 
 

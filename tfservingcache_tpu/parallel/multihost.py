@@ -89,13 +89,19 @@ class GroupWorkHandler:
         mid = ModelId(meta["model"], int(meta["version"]))
         op = meta["op"]
         with self._locks[gi]:  # same-order guarantee as the leader's lock
-            # the leader ships its remaining request budget; an item that
+            # the leader ships its remaining request budget; a PREFETCH that
             # already spent it queued behind the group lock is one the leader
-            # has abandoned (504) — failing fast here keeps one slow op from
-            # pinning the lock for every queued successor (VERDICT r3 weak #5)
+            # has abandoned (504) — fail it fast instead of hammering the
+            # provider for a request nobody is waiting on. ONLY the host-side
+            # joinable phase may be dropped: for collective ops (ensure/
+            # predict/generate/unload) the leader has already entered its
+            # half of the program by the time this runs, so a skipped
+            # follower would wedge the group's collective forever (the
+            # process is healthy — jax.distributed would never flag it)
             budget = meta.get("budget_s")
             if (
-                budget is not None
+                op == "prefetch"
+                and budget is not None
                 and t_arrival is not None
                 and time.monotonic() - t_arrival > float(budget)
             ):

@@ -54,10 +54,17 @@ def _block_attend(q, k, v, q_off, k_off, causal, acc, m, l):
     return acc * alpha + pv, m_new, l_new
 
 
-def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool):
+def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool,
+                   impl: str = "xla", interpret: bool = False):
     """Per-shard body under shard_map: local (B, H, S/P, D) blocks. K/V ride
     the ring in their input dtype — rotating bf16 instead of upcast f32
-    halves the ppermute bytes on ICI."""
+    halves the ppermute bytes on ICI.
+
+    ``impl="flash"`` runs each hop through the Pallas carry kernel
+    (ops/attention.flash_attention_carry): the per-hop (Sq/P x Sk/P) f32
+    score matrix — 64 MB per head-batch at 4k local — never touches HBM,
+    only the O(S/P x D) carry does. ``impl="xla"`` keeps the einsum body
+    (the CPU-harness path and the fallback for shapes the kernel rejects)."""
     idx = jax.lax.axis_index(axis)
     s_local = q.shape[2]
     acc = jnp.zeros(q.shape, jnp.float32)
@@ -72,14 +79,42 @@ def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool):
         # ring position (idx - step) mod P
         src = (idx - step) % n_shards
         k_off = src * s_local
-        acc, m, l = _block_attend(q, k_cur, v_cur, q_off, k_off, causal, acc, m, l)
+        if impl == "flash":
+            from tfservingcache_tpu.ops.attention import flash_attention_carry
+
+            acc, m, l = flash_attention_carry(
+                q, k_cur, v_cur, acc, m, l, k_off - q_off, causal=causal,
+                interpret=interpret,
+            )
+        else:
+            acc, m, l = _block_attend(
+                q, k_cur, v_cur, q_off, k_off, causal, acc, m, l
+            )
         if step + 1 < n_shards:
             k_cur = jax.lax.ppermute(k_cur, axis, perm)
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
+def _pick_impl(impl: str, s_local: int, d: int) -> str:
+    """"auto": Pallas carry kernel on TPU when the shard shape qualifies
+    (128-multiple local seq, MXU-friendly head dim), einsum elsewhere."""
+    if impl != "auto":
+        return impl
+    from tfservingcache_tpu.ops.attention import TPU_BACKENDS
+
+    if (
+        jax.default_backend() in TPU_BACKENDS
+        and s_local % 128 == 0
+        and d % 64 == 0
+    ):
+        return "flash"
+    return "xla"
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "causal", "impl", "interpret")
+)
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -87,17 +122,24 @@ def ring_attention(
     mesh: Mesh,
     axis: str = "seq",
     causal: bool = True,
+    impl: str = "auto",
+    interpret: bool = False,
 ) -> jax.Array:
     """(B, H, S, D) attention with S sharded over ``mesh[axis]``. The full
     sequence never resides on one chip."""
     n_shards = mesh.shape[axis]
     if q.shape[2] % n_shards:
         raise ValueError(f"sequence {q.shape[2]} not divisible by {n_shards} ring shards")
+    impl = _pick_impl(impl, q.shape[2] // n_shards, q.shape[3])
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
-        functools.partial(_ring_shard_fn, axis=axis, n_shards=n_shards, causal=causal),
+        functools.partial(_ring_shard_fn, axis=axis, n_shards=n_shards,
+                          causal=causal, impl=impl, interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call out_shapes carry no varying-mesh-axes metadata, which
+        # the flash body trips over; in/out specs above are explicit
+        check_vma=False,
     )
     return fn(q, k, v)
