@@ -748,8 +748,50 @@ def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dic
     }
 
 
+def collect_watcher_evidence() -> dict:
+    """Fold in TPU-measured rows captured by tools/tpu_bench_watcher.py in
+    whatever tunnel windows this round offered. Each entry is stamped with
+    its capture time; a CPU-fallback driver run therefore still CARRIES the
+    chip evidence instead of erasing it (the r3 failure mode: every number
+    measured pre-outage was lost to the final fallback run)."""
+    out = {}
+    runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_runs")
+    if not os.path.isdir(runs_dir):
+        return out
+    keep_sections = (
+        "mnist_cnn", "transformer_lm", "chip_lm", "flash_kernel",
+        "tenant_soak", "device_kind", "chips", "only",
+    )
+    for fn in sorted(os.listdir(runs_dir)):
+        if not fn.endswith(".json") or fn.endswith(".partial.json"):
+            continue
+        path = os.path.join(runs_dir, fn)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            d = payload.get("detail", payload)
+            if d.get("platform") in (None, "cpu"):
+                continue
+            # prefer the capture stamp embedded by the watcher: file mtime
+            # is rewritten by any clone/checkout and would misdate the chip
+            # measurement
+            measured_at = payload.get("captured_at_utc") or time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(path))
+            )
+            out[fn[:-5]] = {
+                "measured_at": measured_at,
+                **{k: d[k] for k in keep_sections if k in d},
+            }
+        except (OSError, ValueError):
+            continue
+    return out
+
+
 def run(args) -> dict:
     detail = PARTIAL  # sections land here live so the watchdog can salvage
+    watcher = collect_watcher_evidence()
+    if watcher:
+        detail["tpu_watcher_evidence"] = watcher
     sel = _parse_only(args.only)
     want = lambda name: sel is None or name in sel
     if sel is not None:
@@ -1014,8 +1056,29 @@ def main() -> int:
         on_tpu = detail["platform"] != "cpu"
         # a CPU-fallback run (tunnel down) proves the harness, not the perf:
         # its tiny presets against a TPU-hardware target would fabricate a
-        # huge vs_baseline — report 0.0 (not comparable) instead
+        # huge vs_baseline — report 0.0 (not comparable) instead. BUT if the
+        # watcher captured the cold sections on the chip during a tunnel
+        # window, THOSE are the round's real numbers: headline them, stamped.
         tag = "" if on_tpu else " [CPU FALLBACK — vs_baseline not comparable]"
+        if not on_tpu:
+            for unit in ("full", "cold_flash"):
+                ev = detail.get("tpu_watcher_evidence", {}).get(unit)
+                if not ev:
+                    continue
+                ev_p50s = {
+                    fam: ev[fam]["cold_p50_s"]
+                    for fam in ("mnist_cnn", "transformer_lm")
+                    if isinstance(ev.get(fam), dict) and "cold_p50_s" in ev[fam]
+                }
+                if len(ev_p50s) == 2:
+                    p50s = ev_p50s
+                    on_tpu = True  # the headline numbers ARE chip-measured
+                    tag = (
+                        f" [TPU numbers from watcher capture {unit}@"
+                        f"{ev['measured_at']}; final run was cpu fallback]"
+                    )
+                    detail["headline_source"] = f"tpu_watcher_evidence.{unit}"
+                    break
         if not p50s:
             # --only run without a cold section: the sections carry the value
             emit(
@@ -1037,13 +1100,19 @@ def main() -> int:
             f"{'mnist' if fam == 'mnist_cnn' else 'lm'} {v:.2f}s"
             for fam, v in p50s.items()
         )
+        # qps context comes from the same source as the headline p50s
+        src = detail
+        hs = detail.get("headline_source", "")
+        if hs.startswith("tpu_watcher_evidence."):
+            src = detail["tpu_watcher_evidence"][hs.split(".", 1)[1]]
+        lm = src.get("transformer_lm", {})
         emit(
             {
                 "metric": (
                     f"cold_miss_load_to_first_predict_p50 (worst family: "
                     f"{worst_fam}, {detail['platform']}; {fam_bits}; "
-                    f"lm REST {detail.get('transformer_lm', {}).get('warm_rest_qps', 0):.0f} qps "
-                    f"gRPC {detail.get('transformer_lm', {}).get('warm_grpc_qps', 0):.0f} qps)"
+                    f"lm REST {lm.get('warm_rest_qps', 0):.0f} qps "
+                    f"gRPC {lm.get('warm_grpc_qps', 0):.0f} qps)"
                     f"{tag}"
                 ),
                 "value": round(p50, 4),

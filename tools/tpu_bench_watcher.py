@@ -93,17 +93,43 @@ def probe() -> bool:
     return info.get("platform") not in (None, "cpu")
 
 
-def commit(paths: list[str], msg: str) -> None:
-    """Commit just these artifact paths; never sweep concurrent work in."""
+def commit(paths: list[str], msg: str) -> bool:
+    """Commit just these artifact paths; never sweep concurrent work in.
+    Returns success — a failure (index.lock race etc.) is retried by
+    commit_dirty_artifacts() on every loop pass, so evidence is never lost
+    to a transient git error."""
     try:
-        subprocess.run(["git", "add", "--", *paths], cwd=REPO, timeout=60,
-                       capture_output=True)
-        subprocess.run(
+        a = subprocess.run(["git", "add", "--", *paths], cwd=REPO, timeout=60,
+                           capture_output=True, text=True)
+        c = subprocess.run(
             ["git", "commit", "--only", "-m", msg, "--", *paths],
-            cwd=REPO, timeout=60, capture_output=True,
+            cwd=REPO, timeout=60, capture_output=True, text=True,
         )
-    except Exception as e:  # noqa: BLE001 - an index-lock race just retries later
-        log(f"commit skipped: {e}")
+        ok = a.returncode == 0 and c.returncode == 0
+        if not ok:
+            log(f"commit failed (will retry): {(c.stderr or a.stderr)[-200:]}")
+        return ok
+    except Exception as e:  # noqa: BLE001
+        log(f"commit failed (will retry): {e}")
+        return False
+
+
+def commit_dirty_artifacts() -> None:
+    """Self-healing sweep: commit any artifact files a previous (failed)
+    commit left untracked/modified."""
+    try:
+        r = subprocess.run(
+            ["git", "status", "--porcelain", "--", "tpu_runs",
+             "KERNEL_CHECK_r04.txt"],
+            cwd=REPO, timeout=60, capture_output=True, text=True,
+        )
+        dirty = [
+            ln[3:].strip() for ln in r.stdout.splitlines() if ln.strip()
+        ]
+        if dirty:
+            commit(dirty, "TPU watcher: flush artifacts from earlier window")
+    except Exception as e:  # noqa: BLE001
+        log(f"artifact flush failed: {e}")
 
 
 def _has(d: dict, *path) -> bool:
@@ -207,12 +233,19 @@ def run_unit(name: str, argv: list[str], budget_s: float) -> bool:
         log(f"unit {name}: no JSON line (rc={r.returncode})")
         salvage_partial(name, partial_path)
         return False
-    with open(out_path, "w") as f:
-        f.write(line + "\n")
     try:
         payload = json.loads(line)
     except ValueError:
         payload = {}
+    # embed the true capture time: file mtimes are rewritten by any later
+    # clone/checkout, so provenance must live INSIDE the artifact
+    if isinstance(payload, dict):
+        payload["captured_at_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        line = json.dumps(payload)
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
     ok = unit_ok(name, payload)
     on_tpu = payload.get("detail", {}).get("platform") not in (None, "cpu")
     if on_tpu:
@@ -234,6 +267,7 @@ def main() -> int:
     }
     log(f"starting; done units: {[u for u, s in state.items() if s.get('done')]}")
     while True:
+        commit_dirty_artifacts()
         pending = [u for u in UNITS if not state.get(u[0], {}).get("done")]
         if not pending:
             log("all units measured on TPU; idling (re-run to re-measure)")
