@@ -1,0 +1,264 @@
+"""Greedy speculative decoding (models/speculative.py): the load-bearing
+property is EXACTNESS — a draft may change when tokens are computed, never
+which — plus the runtime/REST plumbing (draft resolution, solo execution,
+validation)."""
+
+import json
+
+import aiohttp
+import jax
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.models.generation import generate
+from tfservingcache_tpu.models.registry import build, export_artifact
+from tfservingcache_tpu.models.speculative import speculative_generate
+from tfservingcache_tpu.types import ModelId
+
+CFG_T = {
+    "vocab_size": 128, "d_model": 64, "n_layers": 2, "n_heads": 4,
+    "n_kv_heads": 2, "d_ff": 128, "max_seq": 128, "rope_theta": 10000.0,
+    "dtype": "float32",
+}
+CFG_D = dict(CFG_T, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1, d_ff=64)
+
+
+@pytest.fixture(scope="module")
+def models():
+    mt = build("transformer_lm", CFG_T)
+    md = build("transformer_lm", CFG_D)
+    return mt, mt.init(jax.random.PRNGKey(0)), md, md.init(jax.random.PRNGKey(1))
+
+
+@pytest.mark.parametrize("spec", [1, 3, 4, 7])
+def test_speculative_equals_target_greedy(models, spec):
+    mt, pt, md, pd = models
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (3, 16)).astype(np.int32)
+    lens = np.array([16, 9, 12], np.int32)  # ragged prompts
+    ref = np.asarray(
+        generate(mt, pt, ids, prompt_lengths=lens, max_new_tokens=20,
+                 temperature=0.0)
+    )
+    got = np.asarray(
+        speculative_generate(mt, pt, md, pd, ids, prompt_lengths=lens,
+                             max_new_tokens=20, spec_tokens=spec)
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_speculative_degenerate_and_single_token(models):
+    mt, pt, md, pd = models
+    ids = np.random.default_rng(1).integers(0, 128, (2, 8)).astype(np.int32)
+    ref = np.asarray(generate(mt, pt, ids, max_new_tokens=12, temperature=0.0))
+    # draft == target: every proposal accepted, still exact
+    got = np.asarray(
+        speculative_generate(mt, pt, mt, pt, ids, max_new_tokens=12)
+    )
+    np.testing.assert_array_equal(got, ref)
+    # max_new_tokens=1: the loop body never runs
+    ref1 = np.asarray(generate(mt, pt, ids, max_new_tokens=1, temperature=0.0))
+    got1 = np.asarray(
+        speculative_generate(mt, pt, md, pd, ids, max_new_tokens=1)
+    )
+    np.testing.assert_array_equal(got1, ref1)
+
+
+def test_speculative_validation(models):
+    mt, pt, md, pd = models
+    ids = np.zeros((1, 4), np.int32)
+    bad_vocab = build("transformer_lm", dict(CFG_D, vocab_size=64))
+    with pytest.raises(ValueError, match="vocabulary"):
+        speculative_generate(mt, pt, bad_vocab,
+                             bad_vocab.init(jax.random.PRNGKey(2)), ids)
+    with pytest.raises(ValueError, match="spec_tokens"):
+        speculative_generate(mt, pt, md, pd, ids, spec_tokens=0)
+    mnist = build("mnist_cnn", None)
+    with pytest.raises(ValueError, match="draft"):
+        speculative_generate(mt, pt, mnist, None, ids)
+
+
+@pytest.fixture
+def lm_stack(tmp_path):
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="big", version=1,
+                    seed=0, config=CFG_T)
+    export_artifact("transformer_lm", str(store), name="tiny", version=1,
+                    seed=1, config=CFG_D)
+    runtime = TPUModelRuntime(ServingConfig())
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime,
+    )
+    yield manager, runtime
+    manager.close()
+
+
+def test_runtime_generate_with_draft(lm_stack):
+    manager, runtime = lm_stack
+    big, tiny = ModelId("big", 1), ModelId("tiny", 1)
+    manager.ensure_servable(big)
+    manager.ensure_servable(tiny)
+    ids = np.random.default_rng(2).integers(0, 128, (2, 8)).astype(np.int32)
+    ref = runtime.generate(big, ids, max_new_tokens=10, temperature=0.0)
+    got = runtime.generate(big, ids, max_new_tokens=10, temperature=0.0,
+                           draft_model_id=tiny)
+    np.testing.assert_array_equal(got, ref)
+    # sampled speculative is not implemented: explicit error, not wrong output
+    from tfservingcache_tpu.runtime.base import RuntimeError_
+
+    with pytest.raises(RuntimeError_, match="temperature 0"):
+        runtime.generate(big, ids, temperature=0.7, draft_model_id=tiny)
+
+
+async def test_rest_generate_with_draft(tmp_path):
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.rest import RestServingServer
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="big", version=1,
+                    seed=0, config=CFG_T)
+    export_artifact("transformer_lm", str(store), name="tiny", version=1,
+                    seed=1, config=CFG_D)
+    runtime = TPUModelRuntime(ServingConfig())
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime,
+    )
+    backend = LocalServingBackend(manager)
+    rest = RestServingServer(backend, require_version=False)
+    rport = await rest.start(0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{rport}"
+        ids = [[5, 9, 2, 7, 1, 3, 8, 4]]
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/models/big/versions/1:generate",
+                json={"input_ids": ids, "max_new_tokens": 10,
+                      "temperature": 0.0, "seed": 7},
+            ) as r:
+                assert r.status == 200, await r.text()
+                plain = (await r.json())["tokens"]
+            async with s.post(
+                f"{base}/v1/models/big/versions/1:generate",
+                json={"input_ids": ids, "max_new_tokens": 10,
+                      "draft_model": "tiny"},
+            ) as r:
+                assert r.status == 200, await r.text()
+                spec = (await r.json())["tokens"]
+            assert spec == plain  # exactness through the full REST stack
+            # unknown draft -> 404; malformed -> 400
+            async with s.post(
+                f"{base}/v1/models/big/versions/1:generate",
+                json={"input_ids": ids, "draft_model": "ghost"},
+            ) as r:
+                assert r.status == 404
+            async with s.post(
+                f"{base}/v1/models/big/versions/1:generate",
+                json={"input_ids": ids, "draft_model": {"version": 1}},
+            ) as r:
+                assert r.status == 400
+            # speculative + sampling -> 400 with a clear message
+            async with s.post(
+                f"{base}/v1/models/big/versions/1:generate",
+                json={"input_ids": ids, "draft_model": "tiny",
+                      "temperature": 0.9},
+            ) as r:
+                assert r.status == 400
+                assert "temperature 0" in (await r.json())["error"]
+    finally:
+        backend.close()
+        await rest.close()
+        manager.close()
+
+
+def test_draft_cache_has_no_hole_after_full_acceptance(models):
+    """With draft == target every proposal is accepted; the round count must
+    stay at ceil((m-1)/(spec+1)) for the whole sequence. A hole in the draft
+    cache (the a == spec case before the spec+1-step fix) decays acceptance
+    over the sequence — invisible to exactness, visible here."""
+    mt, pt, _, _ = models
+    ids = np.random.default_rng(3).integers(0, 128, (1, 8)).astype(np.int32)
+    spec, m = 4, 26
+    out, rounds = speculative_generate(
+        mt, pt, mt, pt, ids, max_new_tokens=m, spec_tokens=spec,
+        return_rounds=True,
+    )
+    expected = -(-(m - 1) // (spec + 1))  # every round emits spec+1 tokens
+    assert int(rounds) == expected, (int(rounds), expected)
+    ref = np.asarray(generate(mt, pt, ids, max_new_tokens=m, temperature=0.0))
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_spec_tokens_clamped_to_power_of_two_buckets(lm_stack):
+    """spec_tokens is a jit STATIC arg straight from the request body — the
+    compile-DoS vector temperature/top_k were hardened against. The runtime
+    must clamp it to {1, 2, 4, 8} so the whole space is 4 programs."""
+    manager, runtime = lm_stack
+    big, tiny = ModelId("big", 1), ModelId("tiny", 1)
+    manager.ensure_servable(big)
+    manager.ensure_servable(tiny)
+    ids = np.random.default_rng(4).integers(0, 128, (1, 8)).astype(np.int32)
+    ref = runtime.generate(big, ids, max_new_tokens=6, temperature=0.0)
+    # a huge client value must neither recompile per value nor inflate the
+    # caches: 100000 clamps to 8 (same program as spec_tokens=8)
+    got = runtime.generate(big, ids, max_new_tokens=6, temperature=0.0,
+                           draft_model_id=tiny, spec_tokens=100000)
+    np.testing.assert_array_equal(got, ref)
+    got3 = runtime.generate(big, ids, max_new_tokens=6, temperature=0.0,
+                            draft_model_id=tiny, spec_tokens=3)  # -> 4
+    np.testing.assert_array_equal(got3, ref)
+    from tfservingcache_tpu.runtime.base import RuntimeError_
+
+    with pytest.raises(RuntimeError_, match="spec_tokens"):
+        runtime.generate(big, ids, temperature=0.0, draft_model_id=tiny,
+                         spec_tokens=0)
+
+
+async def test_rest_draft_bad_version_is_400(tmp_path):
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.rest import RestServingServer
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="big", version=1,
+                    seed=0, config=CFG_T)
+    runtime = TPUModelRuntime(ServingConfig())
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime,
+    )
+    backend = LocalServingBackend(manager)
+    rest = RestServingServer(backend, require_version=False)
+    rport = await rest.start(0, host="127.0.0.1")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{rport}/v1/models/big:generate",
+                json={"input_ids": [[1, 2, 3]],
+                      "draft_model": {"name": "big", "version": "abc"}},
+            ) as r:
+                assert r.status == 400, (r.status, await r.text())
+                assert "version" in (await r.json())["error"]
+    finally:
+        backend.close()
+        await rest.close()
+        manager.close()

@@ -1,0 +1,213 @@
+"""Greedy speculative decoding: a small draft model proposes ``spec_tokens``
+tokens per round, the target model verifies them in ONE chunked forward.
+
+No reference counterpart (the reference proxies opaque Predict calls —
+SURVEY.md §5). This is a TPU-shaped throughput feature: plain decode is one
+MXU-starved (B, 1, D) matmul per token, serial in S; verification processes
+``spec+1`` positions per target forward at MXU-friendly width, so accepted
+drafts amortize the expensive model's weight reads over several tokens.
+
+Exactness: at temperature 0 the emitted sequence matches the target
+model's own greedy decode (tokens are only kept while they match the
+target's argmax, and the first mismatch is replaced by the target's own
+choice — the draft can change WHEN tokens are computed, never WHICH).
+``tests/test_speculative.py`` asserts this token-for-token. Caveat: the
+chunked verify forward and the width-1 decode forward are different matmul
+shapes, so on accelerators a near-TIED argmax can round the other way —
+the guarantee is "the target's greedy decode under the verify shapes",
+bitwise on CPU/f32, argmax-tie-sensitive in bf16.
+
+Cache discipline (the part that makes rollback free): a verify chunk always
+starts exactly at the current accepted position, and attention masks reads
+to ``k_pos <= query_pos`` — so K/V rows written for later-rejected tokens
+are invisible until the next chunk overwrites them. "Rollback" is just not
+advancing the position pointer (models/generation.py's mask, reused as-is).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tfservingcache_tpu.models.generation import _forward_cached_dyn, init_cache
+
+
+def _greedy(logits) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg_t_key", "cfg_d_key", "max_new_tokens", "spec_tokens",
+        "family_t", "family_d",
+    ),
+)
+def _speculative_jit(
+    params_t,
+    params_d,
+    input_ids,
+    prompt_len,
+    *,
+    cfg_t_key,
+    cfg_d_key,
+    max_new_tokens: int,
+    spec_tokens: int,
+    family_t: str,
+    family_d: str,
+):
+    cfg_t = dict(cfg_t_key)
+    cfg_d = dict(cfg_d_key)
+    b, s_max = input_ids.shape
+    spec = spec_tokens
+    # slack for chunk writes past the last emitted position (stale rows are
+    # masked off and finished examples may keep writing while others drain)
+    max_len = s_max + max_new_tokens + spec + 1
+    cache_t = init_cache(cfg_t, b, max_len)
+    cache_d = init_cache(cfg_d, b, max_len)
+
+    zeros = jnp.zeros((b,), jnp.int32)
+    logits_t, cache_t = _forward_cached_dyn(
+        params_t, input_ids, cache_t, zeros, cfg_t, family_t
+    )
+    _, cache_d = _forward_cached_dyn(
+        params_d, input_ids, cache_d, zeros, cfg_d, family_d
+    )
+    last = jnp.take_along_axis(
+        logits_t, (prompt_len - 1)[:, None, None], axis=1
+    )[:, 0]
+    first = _greedy(last)
+
+    out = jnp.zeros((b, max_new_tokens), jnp.int32)
+    out = out.at[:, 0].set(first)
+    n_done = jnp.ones((b,), jnp.int32)
+    rows = jnp.arange(b)[:, None]
+    jrange = jnp.arange(spec + 1)
+
+    def cond(carry):
+        _, _, _, n_done, _, _ = carry
+        return jnp.any(n_done < max_new_tokens)
+
+    def body(carry):
+        cache_t, cache_d, cur_tok, n_done, out, rounds = carry
+        # cur_tok is the accepted token AT position pos, not yet in either
+        # cache (the same invariant as generation.py's scan step)
+        pos = prompt_len + n_done - 1
+
+        def draft_step(c, _):
+            cache_d, tok, p = c
+            logits, cache_d = _forward_cached_dyn(
+                params_d, tok[:, None], cache_d, p, cfg_d, family_d
+            )
+            nxt = _greedy(logits[:, 0])
+            return (cache_d, nxt, p + 1), nxt
+
+        # spec+1 steps, not spec: the extra step forwards d_spec so its K/V
+        # row lands in the draft cache. Without it a fully-accepted round
+        # (a == spec) leaves a permanent never-written hole at pos+spec that
+        # every later draft query attends to — silently decaying acceptance
+        # (and the whole speedup) while the target keeps the output correct.
+        (cache_d, _, _), d_toks = jax.lax.scan(
+            draft_step, (cache_d, cur_tok, pos), None, length=spec + 1
+        )
+        d = jnp.transpose(d_toks[:spec], (1, 0))               # (B, spec)
+
+        # one chunked target forward verifies all proposals: logits_j
+        # predicts position pos+1+j
+        chunk = jnp.concatenate([cur_tok[:, None], d], axis=1)  # (B, spec+1)
+        logits_t, cache_t = _forward_cached_dyn(
+            params_t, chunk, cache_t, pos, cfg_t, family_t
+        )
+        g = _greedy(logits_t)                                   # (B, spec+1)
+        matches = (d == g[:, :spec]).astype(jnp.int32)
+        a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)       # (B,) 0..spec
+
+        # emitted this round: d_1..d_a (== g_0..g_{a-1}) then g_a — always
+        # a+1 target-greedy tokens
+        g_at_a = jnp.take_along_axis(g, a[:, None], axis=1)[:, 0]
+        d_pad = jnp.concatenate([d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+        e = jnp.where(
+            jrange[None, :] < a[:, None], d_pad,
+            jnp.where(jrange[None, :] == a[:, None], g_at_a[:, None], 0),
+        )
+        idx = n_done[:, None] + jrange[None, :]
+        valid = (jrange[None, :] <= a[:, None]) & (idx < max_new_tokens)
+        idx = jnp.where(valid, idx, max_new_tokens)             # OOB -> drop
+        out = out.at[rows, idx].set(e, mode="drop")
+
+        n_done = jnp.minimum(n_done + a + 1, max_new_tokens)
+        return cache_t, cache_d, g_at_a, n_done, out, rounds + 1
+
+    _, _, _, _, out, rounds = jax.lax.while_loop(
+        cond, body, (cache_t, cache_d, first, n_done, out, jnp.int32(0))
+    )
+    # rounds is a cheap health signal: a well-aligned draft should emit
+    # ~spec+1 tokens per round; tests use it to catch acceptance decay that
+    # exactness alone can't see (output stays correct regardless)
+    return out, rounds
+
+
+def speculative_generate(
+    model_def_t: Any,
+    params_t: Any,
+    model_def_d: Any,
+    params_d: Any,
+    input_ids,
+    prompt_lengths=None,
+    max_new_tokens: int = 32,
+    spec_tokens: int = 4,
+    return_rounds: bool = False,
+) -> jax.Array:
+    """Greedy decode of the TARGET model, accelerated by the draft.
+
+    Both models must share the decoder-LM cache layout (transformer_lm /
+    moe_lm families) and the same vocabulary. Returns (B, max_new_tokens)
+    int32 matching the target's own greedy decode token-for-token — exactly
+    in exact arithmetic; on accelerators the chunked verify matmul and the
+    width-1 decode matmul may tile/reassociate differently, so a near-tied
+    argmax can break the other way (same caveat as any shape-dependent
+    float reduction). ``return_rounds=True`` also returns the verify-round
+    count — the acceptance-health signal tests use.
+    """
+    for md, role in ((model_def_t, "target"), (model_def_d, "draft")):
+        if md.family not in ("transformer_lm", "moe_lm"):
+            raise ValueError(
+                f"speculative decoding supports transformer_lm/moe_lm "
+                f"{role}s, not {md.family!r}"
+            )
+    if model_def_t.config["vocab_size"] != model_def_d.config["vocab_size"]:
+        raise ValueError(
+            "draft and target must share a vocabulary: "
+            f"{model_def_d.config['vocab_size']} vs "
+            f"{model_def_t.config['vocab_size']}"
+        )
+    if spec_tokens < 1:
+        raise ValueError(f"spec_tokens must be >= 1, got {spec_tokens}")
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, s = input_ids.shape
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((b,), s, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if s + max_new_tokens > model_def_t.config["max_seq"]:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {max_new_tokens} exceeds max_seq "
+            f"{model_def_t.config['max_seq']}"
+        )
+    key = lambda cfg: tuple(sorted((k, v) for k, v in cfg.items()))
+    out, rounds = _speculative_jit(
+        params_t,
+        params_d,
+        input_ids,
+        prompt_lengths,
+        cfg_t_key=key(model_def_t.config),
+        cfg_d_key=key(model_def_d.config),
+        max_new_tokens=max_new_tokens,
+        spec_tokens=spec_tokens,
+        family_t=model_def_t.family,
+        family_d=model_def_d.family,
+    )
+    return (out, rounds) if return_rounds else out

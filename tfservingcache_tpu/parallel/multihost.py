@@ -119,6 +119,13 @@ class GroupWorkHandler:
                 runtime.predict(mid, arrays, meta.get("output_filter") or None)
             elif op == "generate":
                 manager.ensure_servable(mid)
+                draft_mid = (
+                    ModelId(meta["draft_model"], int(meta["draft_version"]))
+                    if meta.get("draft_model")
+                    else None
+                )
+                if draft_mid is not None:
+                    manager.ensure_servable(draft_mid)
                 runtime.generate(
                     mid,
                     arrays["input_ids"],
@@ -127,6 +134,8 @@ class GroupWorkHandler:
                     temperature=float(meta["temperature"]),
                     top_k=int(meta["top_k"]),
                     seed=int(meta["seed"]),  # MUST match the leader's draw
+                    draft_model_id=draft_mid,
+                    spec_tokens=int(meta.get("spec_tokens", 4)),
                 )
             elif op == "unload":
                 runtime.unload(mid)
@@ -314,7 +323,8 @@ class MultiHostGroupRuntime(TPUModelRuntime):
 
     def generate(self, model_id, input_ids, prompt_lengths=None,
                  max_new_tokens: int = 32, temperature: float = 0.0,
-                 top_k: int = 0, seed: int = 0):
+                 top_k: int = 0, seed: int = 0, draft_model_id=None,
+                 spec_tokens: int = 4):
         ids = np.asarray(input_ids, np.int32)
         lengths = (
             np.full((ids.shape[0],), ids.shape[1], np.int32)
@@ -326,12 +336,18 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 "op": "generate", "model": model_id.name,
                 "version": model_id.version, "max_new_tokens": max_new_tokens,
                 "temperature": temperature, "top_k": top_k, "seed": seed,
+                # followers must replay the SAME speculative program: the
+                # draft's forwards are collectives too on a sharded group
+                "draft_model": draft_model_id.name if draft_model_id else "",
+                "draft_version": draft_model_id.version if draft_model_id else 0,
+                "spec_tokens": spec_tokens,
             },
             {"input_ids": ids, "prompt_lengths": lengths},
             lambda: super(MultiHostGroupRuntime, self).generate(
                 model_id, ids, prompt_lengths=list(lengths),
                 max_new_tokens=max_new_tokens, temperature=temperature,
-                top_k=top_k, seed=seed,
+                top_k=top_k, seed=seed, draft_model_id=draft_model_id,
+                spec_tokens=spec_tokens,
             ),
         )
 

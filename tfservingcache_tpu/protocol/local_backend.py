@@ -549,12 +549,20 @@ class LocalServingBackend(ServingBackend):
         """tpusc extension verb ``:generate`` — KV-cached decoding.
 
         Body: {"input_ids": [[...]], "prompt_lengths": [...]?,
-               "max_new_tokens": N?, "temperature": t?, "top_k": k?, "seed": s?}
+               "max_new_tokens": N?, "temperature": t?, "top_k": k?, "seed": s?,
+               "draft_model": "name" | {"name": ..., "version"?: v}?,
+               "spec_tokens": K?}
         Response: {"tokens": [[...]]}.
 
         Omitting "seed" draws fresh entropy per request (distinct samples) and
         lets concurrent same-shape requests coalesce into one device program;
         pass an explicit seed for reproducible (solo) completions.
+
+        "draft_model" enables greedy speculative decoding (temperature must
+        be 0): the draft proposes spec_tokens tokens per round, the target
+        verifies them in one chunked forward — output is bit-identical to
+        the target's own greedy decode. Speculative requests run solo
+        (never coalesced).
 
         The whole request — cold load AND the generate program itself — is
         deadline-bounded by the manager's ``load_timeout_s``: a hung or
@@ -577,8 +585,39 @@ class LocalServingBackend(ServingBackend):
                 grpc.StatusCode.INVALID_ARGUMENT, 400,
             )
 
+        # speculative decoding: resolve + ensure the draft alongside the
+        # target; such requests bypass the coalescer (their device program
+        # depends on the draft pairing, not just the request shape)
+        draft_mid = None
+        draft_spec = payload.get("draft_model")
+        if draft_spec is not None:
+            if isinstance(draft_spec, str):
+                d_name, d_version = draft_spec, None
+            elif isinstance(draft_spec, dict) and draft_spec.get("name"):
+                d_name = draft_spec["name"]
+                d_version = draft_spec.get("version")
+            else:
+                raise BackendError(
+                    '"draft_model" must be a model name or {"name", "version"?}',
+                    grpc.StatusCode.INVALID_ARGUMENT, 400,
+                )
+            try:
+                d_version = int(d_version) if d_version is not None else None
+            except (ValueError, TypeError) as e:
+                raise BackendError(
+                    f'"draft_model" version must be an integer: {e}',
+                    grpc.StatusCode.INVALID_ARGUMENT, 400,
+                ) from e
+            try:
+                d_resolved = self.manager.resolve_version(d_name, d_version)
+            except (KeyError, ModelNotFoundError) as e:
+                raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
+            draft_mid = ModelId(d_name, d_resolved)
+
         def run() -> np.ndarray:
             self._ensure_sync(model_id)
+            if draft_mid is not None:
+                self._ensure_sync(draft_mid)
             gen = self._generator
             try:
                 # inside the try: malformed params ("max_new_tokens": "abc")
@@ -590,7 +629,7 @@ class LocalServingBackend(ServingBackend):
                     top_k=int(payload.get("top_k", 0)),
                 )
                 arr = np.asarray(ids, np.int32)
-                if gen is not None:
+                if gen is not None and draft_mid is None:
                     try:
                         return gen.generate(
                             model_id, arr,
@@ -611,6 +650,8 @@ class LocalServingBackend(ServingBackend):
                         if "seed" in payload
                         else secrets.randbits(31)
                     ),
+                    draft_model_id=draft_mid,
+                    spec_tokens=int(payload.get("spec_tokens", 4)),
                     **kwargs,
                 )
             except (ValueError, TypeError) as e:

@@ -92,6 +92,8 @@ class BaseRuntime(abc.ABC):
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
+        draft_model_id: ModelId | None = None,
+        spec_tokens: int = 4,
     ) -> np.ndarray:
         """KV-cached autoregressive decoding (tpusc extension verb); runtimes
         without a decoder path keep this default."""

@@ -123,6 +123,8 @@ class FakeRuntime(BaseRuntime):
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
+        draft_model_id=None,
+        spec_tokens: int = 4,
     ):
         import numpy as np
 

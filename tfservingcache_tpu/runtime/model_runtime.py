@@ -443,6 +443,8 @@ class TPUModelRuntime(BaseRuntime):
         temperature: float = 0.0,
         top_k: int = 0,
         seed: int = 0,
+        draft_model_id: ModelId | None = None,
+        spec_tokens: int = 4,
     ) -> np.ndarray:
         """KV-cached autoregressive decoding (models/generation.py).
 
@@ -451,6 +453,12 @@ class TPUModelRuntime(BaseRuntime):
         whole bucket; output is truncated back to the requested rows/tokens.
         temperature/top_k are traced into the program (not static), so novel
         sampling configs never trigger a recompile. (B, max_new_tokens) int32.
+
+        ``draft_model_id`` switches to greedy speculative decoding
+        (models/speculative.py): the draft proposes ``spec_tokens`` tokens
+        per round, this model verifies them in one chunked forward; output
+        is bit-identical to its own greedy decode. Requires temperature 0
+        and a loaded draft sharing the vocabulary.
         """
         import math as _math
 
@@ -464,6 +472,27 @@ class TPUModelRuntime(BaseRuntime):
                 f"generate is supported for transformer_lm/moe_lm models, "
                 f"not {loaded.model_def.family!r}"
             )
+        draft = None
+        if draft_model_id is not None:
+            if temperature > 0.0:
+                raise RuntimeError_(
+                    "speculative decoding (draft_model) requires temperature 0 "
+                    "— sampled acceptance is not implemented"
+                )
+            # spec_tokens is a jit STATIC arg fed from the request body: the
+            # same compile-DoS vector _sample's docstring hardens temperature/
+            # top_k against. Clamp to [1, 8] and round up to a power of two
+            # so the whole space mints at most 4 programs (1, 2, 4, 8).
+            if spec_tokens < 1:
+                raise RuntimeError_(
+                    f"spec_tokens must be >= 1, got {spec_tokens}"
+                )
+            spec_tokens = min(next_bucket(min(spec_tokens, 8)), 8)
+            draft = self._resident.get(draft_model_id)
+            if draft is None:
+                raise ModelNotLoadedError(
+                    f"draft model {draft_model_id} is not loaded"
+                )
         from tfservingcache_tpu.models.generation import generate as gen
 
         ids = np.asarray(input_ids, np.int32)
@@ -504,18 +533,35 @@ class TPUModelRuntime(BaseRuntime):
             ids = np.pad(ids, ((0, b_bucket - b), (0, 0)))
             lengths = np.pad(lengths, (0, b_bucket - b), constant_values=1)
         with TRACER.span(
-            "generate", model=str(model_id), tokens=new_bucket, batch=b
+            "generate", model=str(model_id), tokens=new_bucket, batch=b,
+            draft=str(draft_model_id) if draft_model_id else "",
         ):
-            toks = gen(
-                loaded.model_def,
-                loaded.params,
-                ids,
-                prompt_lengths=lengths,
-                max_new_tokens=new_bucket,
-                temperature=temperature,
-                top_k=top_k,
-                rng=jax.random.PRNGKey(seed),
-            )
+            if draft is not None:
+                from tfservingcache_tpu.models.speculative import (
+                    speculative_generate,
+                )
+
+                toks = speculative_generate(
+                    loaded.model_def,
+                    loaded.params,
+                    draft.model_def,
+                    draft.params,
+                    ids,
+                    prompt_lengths=lengths,
+                    max_new_tokens=new_bucket,
+                    spec_tokens=spec_tokens,
+                )
+            else:
+                toks = gen(
+                    loaded.model_def,
+                    loaded.params,
+                    ids,
+                    prompt_lengths=lengths,
+                    max_new_tokens=new_bucket,
+                    temperature=temperature,
+                    top_k=top_k,
+                    rng=jax.random.PRNGKey(seed),
+                )
             if self._mp_mesh:
                 # force the token array fully replicated so this process can
                 # read it (inferred output sharding may split it across hosts);
