@@ -25,6 +25,11 @@ def main(argv: list[str] | None = None) -> int:
     exp.add_argument("dest", help="destination dir (<base>/<name>/<version> is created)")
     exp.add_argument("--name", default=None)
     exp.add_argument("--version", type=int, default=1)
+    exp.add_argument(
+        "--quantize", choices=["int8"], default=None,
+        help="store large float weights as int8 + per-channel scales "
+             "(device dequant at load; halves the cold-path transfer)",
+    )
     rep = sub.add_parser(
         "repack",
         help="rewrite an artifact in the current format (tpusc.v1 msgpack -> "
@@ -52,14 +57,25 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "export":
         from tfservingcache_tpu.models.registry import export_artifact
 
-        path = export_artifact(args.model, args.dest, name=args.name, version=args.version)
+        path = export_artifact(args.model, args.dest, name=args.name,
+                               version=args.version, quantize=args.quantize)
         print(path)
         return 0
     if args.cmd == "repack":
+        import json as _json
+        import os as _os
+
         from tfservingcache_tpu.models.registry import load_artifact, save_artifact
 
+        # carry the source's quantize marker through: repacking an int8
+        # artifact must not silently write a ~2x float artifact
+        try:
+            with open(_os.path.join(args.src, "model.json")) as f:
+                src_quant = _json.load(f).get("quantize")
+        except (OSError, ValueError):
+            src_quant = None
         model, params = load_artifact(args.src)
-        print(save_artifact(args.dest, model, params))
+        print(save_artifact(args.dest, model, params, quantize=src_quant))
         return 0
     return 2
 

@@ -201,10 +201,81 @@ def _leaf_path_str(path) -> str:
 _ALIGN = 16  # every leaf offset 16-byte aligned: valid frombuffer views for
              # any dtype, and friendly to vectorized host copies
 
+# int8 transport quantization floor: leaves below this many elements (norms,
+# biases, small projections) stay in their float dtype — their bytes are
+# noise on the transfer and their dynamic range matters more
+_QUANT_MIN_ELEMS = 65536
 
-def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
+
+class QuantLeaf:
+    """An int8-transported weight: ``q`` (int8) + per-output-channel
+    ``scale`` (f32), dequantized to ``orig_dtype`` ON DEVICE after the
+    host->HBM transfer. Registered as a pytree node (lazily, on first
+    construction — a module-level registration would force the jax import
+    on every light consumer of the registry) so ``packed_device_put`` ships
+    q in the int8 group and scale in the f32 group without special-casing."""
+
+    def __init__(self, q, scale, orig_dtype: str) -> None:
+        _register_quantleaf()
+        self.q = q
+        self.scale = scale
+        self.orig_dtype = orig_dtype
+
+    def dequant_host(self) -> np.ndarray:
+        return (
+            np.asarray(self.q).astype(np.float32) * np.asarray(self.scale)
+        ).astype(np.dtype(self.orig_dtype))
+
+
+def _quantleaf_flatten(ql: QuantLeaf):
+    return (ql.q, ql.scale), ql.orig_dtype
+
+
+def _quantleaf_unflatten(aux, children):
+    return QuantLeaf(children[0], children[1], aux)
+
+
+_QUANTLEAF_REGISTERED = False
+
+
+def _register_quantleaf() -> None:
+    global _QUANTLEAF_REGISTERED
+    if _QUANTLEAF_REGISTERED:
+        return
     import jax
 
+    try:
+        jax.tree_util.register_pytree_node(
+            QuantLeaf, _quantleaf_flatten, _quantleaf_unflatten
+        )
+    except ValueError:
+        pass  # already registered (re-import)
+    _QUANTLEAF_REGISTERED = True
+
+
+def _quantize_int8(a: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-output-channel (last axis) symmetric int8: scale = amax/127 over
+    the reduced axes. The standard weight-only deployment recipe — relative
+    error ~0.4% on smooth weights, invisible next to bf16 compute."""
+    af = a.astype(np.float32)
+    reduce_axes = tuple(range(a.ndim - 1))
+    amax = np.max(np.abs(af), axis=reduce_axes, keepdims=True)
+    scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+    q = np.clip(np.round(af / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def save_artifact(dest_dir: str, model: ModelDef, params: Any,
+                  quantize: str | None = None) -> str:
+    """``quantize="int8"`` stores large float weights as int8 + per-channel
+    f32 scales: the host->HBM transfer that dominates the cold-miss path
+    ships ~half the bytes of a bf16 artifact (~quarter of f32), and the
+    runtime dequantizes on device. Opt-in per export — outputs differ from
+    the unquantized artifact by the quantization error."""
+    import jax
+
+    if quantize not in (None, "int8"):
+        raise ArtifactError(f"unsupported quantize scheme {quantize!r}")
     os.makedirs(dest_dir, exist_ok=True)
     if model.store_param_dtype:
         nd = np.dtype(model.store_param_dtype)
@@ -228,30 +299,59 @@ def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
     # leaves stream straight to disk — a llama-class artifact must not hold
     # a second full copy of its params in host memory during export
     with open(os.path.join(dest_dir, PARAMS_BIN), "wb") as f:
-        for _, (path, leaf) in flat:
-            a = np.ascontiguousarray(np.asarray(leaf))
+        def write_aligned(buf: bytes) -> int:
+            nonlocal offset
             pad = (-offset) % _ALIGN
             if pad:
                 f.write(b"\0" * pad)
                 offset += pad
-            manifest.append(
-                {
-                    "path": _leaf_path_str(path),
-                    "dtype": a.dtype.name,
-                    "shape": list(a.shape),
-                    "offset": offset,
-                    "nbytes": a.nbytes,
-                }
+            start = offset
+            f.write(buf)
+            offset += len(buf)
+            return start
+
+        for _, (path, leaf) in flat:
+            a = np.ascontiguousarray(np.asarray(leaf))
+            entry = {
+                "path": _leaf_path_str(path),
+                "dtype": a.dtype.name,
+                "shape": list(a.shape),
+            }
+            # extension float dtypes (bfloat16) report kind 'V', not 'f' —
+            # match by name too or every bf16 artifact would silently skip
+            # quantization
+            is_float = a.dtype.kind == "f" or a.dtype.name in (
+                "bfloat16", "float16"
             )
-            # tobytes, not .data: extension dtypes (bfloat16) have no buffer
-            # protocol; this copies one leaf at a time, never the whole tree
-            f.write(a.tobytes())
-            offset += a.nbytes
+            if (
+                quantize == "int8"
+                and is_float
+                and a.ndim >= 2
+                and a.size >= _QUANT_MIN_ELEMS
+            ):
+                q, scale = _quantize_int8(a)
+                entry["dtype"] = "int8"
+                entry["offset"] = write_aligned(q.tobytes())
+                entry["nbytes"] = q.nbytes
+                entry["quant"] = {
+                    "orig_dtype": a.dtype.name,
+                    "scale_dtype": "float32",
+                    "scale_shape": list(scale.shape),
+                    "scale_offset": write_aligned(scale.tobytes()),
+                    "scale_nbytes": scale.nbytes,
+                }
+            else:
+                # tobytes, not .data: extension dtypes (bfloat16) have no
+                # buffer protocol; copies one leaf at a time, never the tree
+                entry["offset"] = write_aligned(a.tobytes())
+                entry["nbytes"] = a.nbytes
+            manifest.append(entry)
     meta = {
         "format": ARTIFACT_FORMAT,
         "family": model.family,
         "config": model.config,
         "param_dtype": model.store_param_dtype,
+        "quantize": quantize,
         "params": {"file": PARAMS_BIN, "manifest": manifest},
         "signature": {
             "inputs": {k: [v.dtype, list(v.shape)] for k, v in model.input_spec.items()},
@@ -266,8 +366,14 @@ def save_artifact(dest_dir: str, model: ModelDef, params: Any) -> str:
     return dest_dir
 
 
-def load_artifact(path: str) -> tuple[ModelDef, Any]:
-    """-> (ModelDef, params pytree). Raises ArtifactError on malformed dirs."""
+def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
+    """-> (ModelDef, params pytree). Raises ArtifactError on malformed dirs.
+
+    ``raw_quant=True`` returns int8-quantized leaves as ``QuantLeaf`` views
+    (q + scale) instead of dequantizing on the host — the runtime's packed
+    transfer ships those raw bytes and dequantizes on DEVICE, which is the
+    whole point of the int8 artifact. Generic callers keep the default and
+    get ordinary float arrays."""
     meta_path = os.path.join(path, MODEL_JSON)
     if not os.path.exists(meta_path):
         raise ArtifactError(f"not a TPUSavedModel artifact (no {MODEL_JSON}): {path}")
@@ -307,6 +413,20 @@ def load_artifact(path: str) -> tuple[ModelDef, Any]:
         arr = np.frombuffer(blob.data, dtype=dt, count=n, offset=off).reshape(
             ent["shape"]
         )
+        quant = ent.get("quant")
+        if quant is not None:
+            sdt = np.dtype(quant.get("scale_dtype", "float32"))
+            sn = int(np.prod(quant["scale_shape"])) if quant["scale_shape"] else 1
+            soff, snb = int(quant["scale_offset"]), int(quant["scale_nbytes"])
+            if snb != sn * sdt.itemsize or soff + snb > blob.nbytes:
+                raise ArtifactError(
+                    f"corrupt quant scales for {ent['path']!r} in {bin_path}"
+                )
+            scale = np.frombuffer(
+                blob.data, dtype=sdt, count=sn, offset=soff
+            ).reshape(quant["scale_shape"])
+            ql = QuantLeaf(arr, scale, quant["orig_dtype"])
+            arr = ql if raw_quant else ql.dequant_host()
         if ent["path"] == "":
             return model, arr  # params was a single bare array
         node = nested
@@ -335,6 +455,7 @@ def export_artifact(
     version: int = 1,
     config: dict[str, Any] | None = None,
     seed: int = 0,
+    quantize: str | None = None,
 ) -> str:
     """Initialize a family with fresh params and write
     ``<base_dir>/<name>/<version>/`` (used by the CLI, tests and bench).
@@ -355,4 +476,4 @@ def export_artifact(
     else:
         params = model.init(jax.random.PRNGKey(seed))
     dest = os.path.join(base_dir, name or family, str(version))
-    return save_artifact(dest, model, params)
+    return save_artifact(dest, model, params, quantize=quantize)

@@ -126,6 +126,55 @@ def packed_device_put(host_params: Any, device: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+@functools.lru_cache(maxsize=None)
+def _dequant_fn(orig_dtype: str):
+    """Cached jitted per-leaf dequant (q int8, scale f32) -> orig_dtype.
+    The jit cache further keys on shapes, so every tenant of a family
+    reuses one executable per leaf shape."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(
+        lambda q, s: (q.astype(jnp.float32) * s).astype(jnp.dtype(orig_dtype))
+    )
+
+
+def _dequantize_on_device(params: Any) -> Any:
+    """Expand QuantLeaf nodes (int8 q + f32 scale, already device-resident)
+    into their original float dtype on device — the compute side of the
+    int8 artifact transport. The q/scale references are dropped leaf by
+    leaf as outputs materialize, so the transient HBM overshoot stays
+    ~one leaf, not int8-tree + float-tree at once (the same bounded-
+    overshoot discipline as packed_device_put's chunking)."""
+    import jax
+
+    from tfservingcache_tpu.models.registry import QuantLeaf
+
+    def leaf(x):
+        if isinstance(x, QuantLeaf):
+            out = _dequant_fn(x.orig_dtype)(x.q, x.scale)
+            x.q = x.scale = None  # free the int8 buffer once XLA is done
+            return out
+        return x
+
+    return jax.tree_util.tree_map(
+        leaf, params, is_leaf=lambda x: isinstance(x, QuantLeaf)
+    )
+
+
+def _dequantize_on_host(params: Any) -> Any:
+    """Host-side expansion for the sharded branch (partition rules name
+    float leaves)."""
+    import jax
+
+    from tfservingcache_tpu.models.registry import QuantLeaf
+
+    return jax.tree_util.tree_map(
+        lambda x: x.dequant_host() if isinstance(x, QuantLeaf) else x,
+        params, is_leaf=lambda x: isinstance(x, QuantLeaf),
+    )
+
+
 @dataclass
 class LoadedModel:
     model_def: ModelDef
@@ -209,20 +258,32 @@ class TPUModelRuntime(BaseRuntime):
         try:
             self._set_state(mid, ModelState.LOADING)
             with TRACER.span("artifact_read"):
-                model_def, host_params = load_artifact(model.path)
+                # always read int8 artifacts RAW (q + scales): which branch
+                # dequantizes where is only known after the family's
+                # partition rules are in hand
+                model_def, host_params = load_artifact(
+                    model.path, raw_quant=True
+                )
             if self.mesh is not None and model_def.partition_rules:
                 # multi-chip model: params sharded over the chip group per the
                 # family's partition rules; XLA partitions the computation and
-                # inserts ICI collectives from the committed shardings
+                # inserts ICI collectives from the committed shardings.
+                # Quant leaves dequantize on HOST first — the rules name
+                # float leaves, not q/scale pairs.
                 from tfservingcache_tpu.parallel.sharding import shard_params
 
+                host_params = _dequantize_on_host(host_params)
                 with TRACER.span("device_transfer"):
                     params = shard_params(
                         host_params, model_def.partition_rules, self.mesh
                     )
             else:
+                # packed path ships the raw int8 bytes — the transfer is the
+                # cold-path bottleneck the int8 artifact exists to halve —
+                # and dequantizes on device
                 with TRACER.span("device_transfer"):
                     params = packed_device_put(host_params, self._devices[0])
+                    params = _dequantize_on_device(params)
             key = model_def.cache_key
             # mesh-aware families (ring/context-parallel attention) build
             # their apply against THIS group's mesh; per-runtime jit cache
