@@ -160,7 +160,8 @@ def _peak_flops(device_kind: str) -> float | None:
 
 
 def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
-                config: dict | None = None, resident_cap: int | None = None):
+                config: dict | None = None, resident_cap: int | None = None,
+                quantize: str | None = None):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
@@ -171,7 +172,7 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
     store = os.path.join(tmp, f"store-{family}")
     for i in range(tenants):
         export_artifact(family, store, name=f"tenant{i}", version=1, seed=i,
-                        config=config)
+                        config=config, quantize=quantize)
     provider = DiskModelProvider(store)
     cache = ModelDiskCache(
         os.path.join(tmp, f"cache-{family}"), capacity_bytes=64 << 30
@@ -229,8 +230,8 @@ def _section(name: str):
 # implicit in run(): a selected QPS group forces its family's cold section
 # (the stack it measures is built there).
 SECTION_GROUPS = (
-    "mnist_cold", "lm_cold", "flash_kernel", "chip_lm", "mnist_qps",
-    "routed", "lm_throughput", "lm_qps", "tenant_soak",
+    "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
+    "mnist_qps", "routed", "lm_throughput", "lm_qps", "tenant_soak",
 )
 
 
@@ -301,8 +302,8 @@ def _input_variants(family: str, batch: int, config: dict | None,
 
 
 _COLD_STAGES = (
-    "provider_fetch", "artifact_read", "device_transfer", "compile_warmup",
-    "transfer_sync",
+    "provider_fetch", "artifact_read", "device_transfer", "device_dequant",
+    "compile_warmup", "transfer_sync",
 )
 
 
@@ -341,14 +342,15 @@ def _cold_stage_breakdown(traces: list[dict]) -> dict:
 
 
 def bench_cold(family: str, tenants: int, batch: int, tmp: str,
-               config: dict | None = None) -> tuple:
+               config: dict | None = None, quantize: str | None = None) -> tuple:
     """Cold-miss loop: every tenant's first request through the CacheManager."""
     import numpy as np
 
     from tfservingcache_tpu.types import ModelId
     from tfservingcache_tpu.utils.tracing import TRACER
 
-    manager, runtime = _make_stack(family, tenants, tmp, config=config)
+    manager, runtime = _make_stack(family, tenants, tmp, config=config,
+                                   quantize=quantize)
     inputs = _example_inputs(family, batch, config)
     TRACER.clear()
     times = []
@@ -927,6 +929,32 @@ def run(args) -> dict:
             )
         detail["transformer_lm"] = dict(lm_cold)
         detail["transformer_lm"]["tenants"] = lm_tenants
+
+    # int8 artifact transport: same LM preset, quantized artifacts — the
+    # cold p50 delta vs the bf16 row above IS the transfer-bytes claim
+    # (README "int8 artifacts") measured end-to-end
+    if want("lm_cold_q8"):
+        q8_manager = None
+        try:
+            with _section("lm_cold_q8"):
+                q8_cold, q8_manager, _, _ = bench_cold(
+                    "transformer_lm", max(4, lm_tenants // 2), args.lm_batch,
+                    os.path.join(tmp, "q8"), config=lm_config,
+                    quantize="int8",
+                )
+            detail["transformer_lm_q8"] = {
+                k: round(v, 4) if isinstance(v, float) else v
+                for k, v in q8_cold.items()
+            }
+        except Exception as e:  # noqa: BLE001 - the bf16 rows stand alone
+            detail.setdefault(
+                "transformer_lm_q8", {"error": f"{type(e).__name__}: {e}"}
+            )
+        finally:
+            # close before later sections measure: a leaked q8 stack would
+            # sit resident in HBM under the flash/chip/QPS rows
+            if q8_manager is not None:
+                q8_manager.close()
 
     if want("flash_kernel"):
         try:

@@ -172,3 +172,32 @@ def test_mesh_runtime_without_rules_ships_raw_int8(tmp_path, monkeypatch):
         assert calls, "device dequant did not run on the packed path"
     finally:
         manager.close()
+
+
+def test_repack_is_lossless_for_int8(tmp_path):
+    """Repack must carry the original q/scale BYTES through — requantizing
+    dequantized values would drift scales and compound error per repack."""
+    from tfservingcache_tpu.cli import main as cli_main
+
+    src = export_artifact("transformer_lm", str(tmp_path / "src"), name="m",
+                          version=1, seed=0, config=LM_CFG, quantize="int8")
+    dest = str(tmp_path / "dest")
+    assert cli_main(["repack", src, dest]) == 0
+    _, p_src = load_artifact(src, raw_quant=True)
+    _, p_dest = load_artifact(dest, raw_quant=True)
+    import jax
+
+    is_ql = lambda x: isinstance(x, QuantLeaf)
+    src_leaves = jax.tree_util.tree_leaves(p_src, is_leaf=is_ql)
+    dest_leaves = jax.tree_util.tree_leaves(p_dest, is_leaf=is_ql)
+    n_quant = 0
+    for a, b in zip(src_leaves, dest_leaves):
+        if isinstance(a, QuantLeaf):
+            n_quant += 1
+            assert isinstance(b, QuantLeaf)
+            np.testing.assert_array_equal(np.asarray(a.q), np.asarray(b.q))
+            np.testing.assert_array_equal(
+                np.asarray(a.scale), np.asarray(b.scale)
+            )
+            assert a.orig_dtype == b.orig_dtype
+    assert n_quant >= 8

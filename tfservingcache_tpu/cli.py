@@ -67,14 +67,16 @@ def main(argv: list[str] | None = None) -> int:
 
         from tfservingcache_tpu.models.registry import load_artifact, save_artifact
 
-        # carry the source's quantize marker through: repacking an int8
-        # artifact must not silently write a ~2x float artifact
+        # carry the source's quantize marker AND bytes through: raw_quant
+        # returns QuantLeaf views that save_artifact writes verbatim —
+        # dequantize-then-requantize would shift scales and compound error
+        # on every repack
         try:
             with open(_os.path.join(args.src, "model.json")) as f:
                 src_quant = _json.load(f).get("quantize")
         except (OSError, ValueError):
             src_quant = None
-        model, params = load_artifact(args.src)
+        model, params = load_artifact(args.src, raw_quant=True)
         print(save_artifact(args.dest, model, params, quantize=src_quant))
         return 0
     return 2

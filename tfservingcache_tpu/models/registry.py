@@ -281,18 +281,33 @@ def save_artifact(dest_dir: str, model: ModelDef, params: Any,
         nd = np.dtype(model.store_param_dtype)
 
         def cast(x):
+            if isinstance(x, QuantLeaf):
+                return x
             a = np.asarray(x)
             return a.astype(nd) if a.dtype.kind == "f" and a.dtype != nd else a
 
-        params = jax.tree_util.tree_map(cast, params)
+        params = jax.tree_util.tree_map(
+            cast, params, is_leaf=lambda x: isinstance(x, QuantLeaf)
+        )
 
-    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    # QuantLeaf inputs (a raw_quant re-save, e.g. cli repack) are carried
+    # through VERBATIM — dequantize-then-requantize would shift scales and
+    # compound error on every repack
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, QuantLeaf)
+    )
+
+    def _leaf_dtype_name(leaf) -> str:
+        if isinstance(leaf, QuantLeaf):
+            return "int8"
+        return np.asarray(leaf).dtype.name
+
     # group by dtype so the runtime's per-dtype packed transfer reads
     # contiguous file segments. dtype NAME, not .str: extension dtypes
     # (bfloat16) stringify to the void '|V2' under .str and would not
     # round-trip through np.dtype()
     flat = sorted(
-        enumerate(flat), key=lambda e: (np.asarray(e[1][1]).dtype.name, e[0])
+        enumerate(flat), key=lambda e: (_leaf_dtype_name(e[1][1]), e[0])
     )
     manifest = []
     offset = 0
@@ -310,7 +325,27 @@ def save_artifact(dest_dir: str, model: ModelDef, params: Any,
             offset += len(buf)
             return start
 
+        def write_quant(entry, q, scale, orig_dtype: str):
+            entry["dtype"] = "int8"
+            entry["offset"] = write_aligned(q.tobytes())
+            entry["nbytes"] = q.nbytes
+            entry["quant"] = {
+                "orig_dtype": orig_dtype,
+                "scale_dtype": "float32",
+                "scale_shape": list(scale.shape),
+                "scale_offset": write_aligned(scale.tobytes()),
+                "scale_nbytes": scale.nbytes,
+            }
+
         for _, (path, leaf) in flat:
+            if isinstance(leaf, QuantLeaf):
+                q = np.ascontiguousarray(np.asarray(leaf.q))
+                entry = {"path": _leaf_path_str(path), "shape": list(q.shape)}
+                write_quant(entry, q,
+                            np.ascontiguousarray(np.asarray(leaf.scale)),
+                            leaf.orig_dtype)
+                manifest.append(entry)
+                continue
             a = np.ascontiguousarray(np.asarray(leaf))
             entry = {
                 "path": _leaf_path_str(path),
@@ -330,16 +365,7 @@ def save_artifact(dest_dir: str, model: ModelDef, params: Any,
                 and a.size >= _QUANT_MIN_ELEMS
             ):
                 q, scale = _quantize_int8(a)
-                entry["dtype"] = "int8"
-                entry["offset"] = write_aligned(q.tobytes())
-                entry["nbytes"] = q.nbytes
-                entry["quant"] = {
-                    "orig_dtype": a.dtype.name,
-                    "scale_dtype": "float32",
-                    "scale_shape": list(scale.shape),
-                    "scale_offset": write_aligned(scale.tobytes()),
-                    "scale_nbytes": scale.nbytes,
-                }
+                write_quant(entry, q, scale, a.dtype.name)
             else:
                 # tobytes, not .data: extension dtypes (bfloat16) have no
                 # buffer protocol; copies one leaf at a time, never the tree

@@ -283,6 +283,9 @@ class TPUModelRuntime(BaseRuntime):
                 # and dequantizes on device
                 with TRACER.span("device_transfer"):
                     params = packed_device_put(host_params, self._devices[0])
+                # own span: dequant compiles/compute must not inflate the
+                # transfer stage the q8 bench row exists to measure
+                with TRACER.span("device_dequant"):
                     params = _dequantize_on_device(params)
             key = model_def.cache_key
             # mesh-aware families (ring/context-parallel attention) build

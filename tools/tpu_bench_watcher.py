@@ -40,7 +40,7 @@ SLEEP_DOWN_S = 180.0
 UNITS: list[tuple[str, list[str], float]] = [
     ("kernel_check", ["tools/tpu_kernel_check.py"], 1200.0),
     ("chip_lm", ["bench.py", "--only", "chip_lm"], 1500.0),
-    ("cold_flash", ["bench.py", "--only", "mnist_cold,lm_cold,flash_kernel"],
+    ("cold_flash", ["bench.py", "--only", "mnist_cold,lm_cold,lm_cold_q8,flash_kernel"],
      1500.0),
     ("batcher_qps", ["bench.py", "--only", "mnist_qps,lm_qps,lm_throughput"],
      1800.0),
@@ -152,6 +152,7 @@ def unit_ok(name: str, payload: dict) -> bool:
         "cold_flash": [
             ("mnist_cnn", "cold_p50_s"),
             ("transformer_lm", "cold_p50_s"),
+            ("transformer_lm_q8", "cold_p50_s"),
             ("flash_kernel", "bench_shape", "speedup"),
         ],
         "batcher_qps": [
