@@ -1,0 +1,152 @@
+"""Prefix KV cache (runtime/prefix_cache.py + the generate fast path):
+exactness is the load-bearing property — a cached-prefix continuation must
+emit exactly what the plain path would — plus LRU/budget/invalidation."""
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.runtime.prefix_cache import PrefixCache, _bucket
+from tfservingcache_tpu.types import ModelId
+
+CFG = {
+    "vocab_size": 128, "d_model": 64, "n_layers": 2, "n_heads": 4,
+    "n_kv_heads": 2, "d_ff": 128, "max_seq": 128, "rope_theta": 10000.0,
+    "dtype": "float32",
+}
+
+
+class _Arr:
+    """Minimal array stub with nbytes (unit tests need no device arrays)."""
+
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+
+
+def test_prefix_cache_lru_budget_and_lookup():
+    pc = PrefixCache(capacity_bytes=100)
+    mid = ModelId("m", 1)
+    toks = np.arange(10, dtype=np.int32)
+    pc.insert(mid, toks, _Arr(30), _Arr(10), 10)
+    assert len(pc) == 1 and pc.total_bytes == 40
+    # longest strict prefix wins; exact-length entries present at S-1
+    hit = pc.lookup(mid, np.arange(12, dtype=np.int32))
+    assert hit is not None and hit.valid_len == 10
+    hit = pc.lookup(mid, toks)  # same prompt: usable capped at 9
+    assert hit is not None and hit.valid_len == 9
+    assert pc.lookup(mid, np.array([5, 6], np.int32)) is None
+    assert pc.lookup(ModelId("other", 1), toks) is None
+    # mismatched tokens never match
+    bad = toks.copy(); bad[0] = 99
+    assert pc.lookup(mid, bad) is None
+    # budget eviction: LRU order
+    pc.insert(mid, np.arange(20, 40, dtype=np.int32), _Arr(30), _Arr(10), 20)
+    pc.insert(mid, np.arange(40, 60, dtype=np.int32), _Arr(30), _Arr(10), 20)
+    assert pc.total_bytes <= 100 and len(pc) == 2
+    # over-budget single entry is refused, cache untouched
+    pc.insert(mid, np.arange(5, dtype=np.int32), _Arr(900), _Arr(10), 5)
+    assert pc.total_bytes <= 100
+    pc.drop_model(mid)
+    assert len(pc) == 0 and pc.total_bytes == 0
+
+
+@pytest.fixture
+def stacks(tmp_path):
+    def make(prefix_bytes):
+        store = tmp_path / f"store{prefix_bytes}"
+        export_artifact("transformer_lm", str(store), name="m", version=1,
+                        seed=0, config=CFG)
+        runtime = TPUModelRuntime(
+            ServingConfig(prefix_cache_bytes=prefix_bytes)
+        )
+        manager = CacheManager(
+            DiskModelProvider(str(store)),
+            ModelDiskCache(str(tmp_path / f"cache{prefix_bytes}"),
+                           capacity_bytes=1 << 30),
+            runtime,
+        )
+        manager.ensure_servable(ModelId("m", 1))
+        return manager, runtime
+
+    made = []
+
+    def factory(prefix_bytes):
+        m = make(prefix_bytes)
+        made.append(m[0])
+        return m
+
+    yield factory
+    for m in made:
+        m.close()
+
+
+@pytest.mark.parametrize(
+    "temp,top_k,seed,max_new",
+    [
+        (0.0, 0, 0, 8),
+        (0.9, 16, 11, 8),
+        # non-power-of-two max_new: the bucket pads generation to 8 but the
+        # client only ever sees 5 tokens — the entry must stop there or
+        # every conversation is a permanent miss (review repro)
+        (0.0, 0, 3, 5),
+    ],
+)
+def test_two_turn_conversation_exact(stacks, temp, top_k, seed, max_new):
+    """Turn 2's prompt extends turn 1's prompt + completion: the cached-
+    prefix continuation must equal the plain path token-for-token (greedy
+    AND seeded sampling — the rng split structure is shared)."""
+    _, rt_on = stacks(64 << 20)
+    _, rt_off = stacks(0)
+    mid = ModelId("m", 1)
+    rng = np.random.default_rng(0)
+    prompt1 = rng.integers(0, 128, (1, 12)).astype(np.int32)
+
+    kw = dict(max_new_tokens=max_new, temperature=temp, top_k=top_k, seed=seed)
+    t1_on = rt_on.generate(mid, prompt1, **kw)
+    t1_off = rt_off.generate(mid, prompt1, **kw)
+    np.testing.assert_array_equal(t1_on, t1_off)
+    assert rt_on._prefix_cache.misses >= 1
+
+    # turn 2: history + new user tokens
+    extra = rng.integers(0, 128, (1, 5)).astype(np.int32)
+    prompt2 = np.concatenate([prompt1, t1_on, extra], axis=1)
+    kw2 = dict(max_new_tokens=max_new, temperature=temp, top_k=top_k,
+               seed=seed + 1)
+    t2_on = rt_on.generate(mid, prompt2, **kw2)
+    t2_off = rt_off.generate(mid, prompt2, **kw2)
+    np.testing.assert_array_equal(t2_on, t2_off)
+    assert rt_on._prefix_cache.hits >= 1, (
+        rt_on._prefix_cache.hits, rt_on._prefix_cache.misses
+    )
+
+    # repeated identical prompt also hits (at S-1) and stays exact
+    t2_again = rt_on.generate(mid, prompt2, **kw2)
+    np.testing.assert_array_equal(t2_again, t2_off)
+
+
+def test_prefix_entries_dropped_on_unload(stacks):
+    _, rt = stacks(64 << 20)
+    mid = ModelId("m", 1)
+    prompt = np.random.default_rng(1).integers(0, 128, (1, 10)).astype(np.int32)
+    rt.generate(mid, prompt, max_new_tokens=8)  # valid 18 rows >= 16 floor
+    assert len(rt._prefix_cache) >= 1
+    rt.unload(mid)
+    assert len(rt._prefix_cache) == 0
+
+
+def test_batched_requests_skip_prefix_path(stacks):
+    _, rt = stacks(64 << 20)
+    mid = ModelId("m", 1)
+    prompts = np.random.default_rng(2).integers(0, 128, (3, 10)).astype(np.int32)
+    out = rt.generate(mid, prompts, max_new_tokens=4)
+    assert out.shape == (3, 4)
+    assert len(rt._prefix_cache) == 0  # B>1 never touches the cache
+
+
+def test_bucket_helper():
+    assert _bucket(1) == 16 and _bucket(16) == 16 and _bucket(17) == 32

@@ -44,6 +44,10 @@ class ServingConfig:
     # into one MXU dispatch (bench.py records QPS batcher on vs off).
     batch_window_ms: float = 2.0
     batch_max_size: int = 64
+    # Prefix KV cache for :generate (runtime/prefix_cache.py): byte budget
+    # of device memory for reusable prompt-prefix K/V. 0 = off (default —
+    # entries hold real HBM). Single-group runtimes only; B=1 requests.
+    prefix_cache_bytes: int = 0
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

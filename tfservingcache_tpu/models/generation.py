@@ -65,9 +65,31 @@ def _sample(logits, rng, temperature, top_k):
     return jnp.where(jnp.asarray(temperature, jnp.float32) <= 0.0, greedy, sampled)
 
 
+def _decode_scan(params, cache, first_tok, start_pos, rng, temperature,
+                 top_k, cfg, family, max_new_tokens: int):
+    """The shared sampling scan: ``first_tok`` sits at ``start_pos`` (not
+    yet in cache); emits max_new_tokens including it. The rng split
+    structure is FIXED (one split per step) so the plain and from-cache
+    paths draw identical streams for the same seed."""
+
+    def step(carry, _):
+        cache, tok, pos, rng = carry
+        logits, cache = _forward_cached_dyn(
+            params, tok[:, None], cache, pos, cfg, family
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, 0], sub, temperature, top_k)
+        return (cache, nxt, pos + 1, rng), tok
+
+    (cache, _, _, _), toks = jax.lax.scan(
+        step, (cache, first_tok, start_pos, rng), None, length=max_new_tokens
+    )
+    return jnp.transpose(toks, (1, 0)), cache  # (B, max_new_tokens)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg_key", "max_new_tokens", "family"),
+    static_argnames=("cfg_key", "max_new_tokens", "family", "return_cache"),
 )
 def _generate_jit(
     params,
@@ -80,6 +102,7 @@ def _generate_jit(
     cfg_key,
     max_new_tokens: int,
     family: str = "transformer_lm",
+    return_cache: bool = False,
 ):
     cfg = dict(cfg_key)
     b, s_max = input_ids.shape
@@ -97,21 +120,70 @@ def _generate_jit(
     rng, sub = jax.random.split(rng)
     tok = _sample(last, sub, temperature, top_k)
 
-    def step(carry, _):
-        cache, tok, pos, rng = carry
-        logits, cache = _forward_cached_one(params, tok, cache, pos, cfg)
-        rng, sub = jax.random.split(rng)
-        nxt = _sample(logits[:, 0], sub, temperature, top_k)
-        return (cache, nxt, pos + 1, rng), tok
-
-    def _forward_cached_one(params, tok, cache, pos, cfg):
-        # single-token step at per-example positions ``pos`` (B,)
-        return _forward_cached_dyn(params, tok[:, None], cache, pos, cfg, family)
-
-    (cache, _, _, _), toks = jax.lax.scan(
-        step, (cache, tok, prompt_len, rng), None, length=max_new_tokens
+    toks, cache = _decode_scan(
+        params, cache, tok, prompt_len, rng, temperature, top_k, cfg, family,
+        max_new_tokens,
     )
-    return jnp.transpose(toks, (1, 0))  # (B, max_new_tokens)
+    if return_cache:
+        return toks, cache["k"], cache["v"]
+    return toks
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg_key", "max_new_tokens", "family", "return_cache"),
+)
+def _generate_from_cache_jit(
+    params,
+    suffix_ids,          # (1, S_suffix_pad) — prompt tokens AFTER the prefix
+    suffix_len,          # (1,) true suffix length
+    cached_k,            # (layers, 1, n_kv, Lpad, head_dim)
+    cached_v,
+    cached_len,          # (1,) valid prefix rows (the rest is masked junk)
+    rng,
+    temperature,
+    top_k,
+    *,
+    cfg_key,
+    max_new_tokens: int,
+    family: str = "transformer_lm",
+    return_cache: bool = False,
+):
+    """Continue from a cached prompt-prefix KV: copy the prefix rows in,
+    prefill ONLY the suffix, then the shared decode scan. Junk rows beyond
+    ``cached_len`` (entry padding / stale tail) are overwritten by the
+    suffix prefill and the per-step writes before any query can see them —
+    the same argument that makes plain prefill's pad rows safe."""
+    cfg = dict(cfg_key)
+    b, s_pad = suffix_ids.shape
+    l_pad = cached_k.shape[3]
+    max_len = l_pad + s_pad + max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], cached_k.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], cached_v.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
+        ),
+    }
+    start = cached_len.astype(jnp.int32)                  # (1,)
+    logits, cache = _forward_cached_dyn(
+        params, suffix_ids, cache, start, cfg, family
+    )
+    last = jnp.take_along_axis(
+        logits, (suffix_len - 1)[:, None, None], axis=1
+    )[:, 0]
+    rng, sub = jax.random.split(rng)
+    tok = _sample(last, sub, temperature, top_k)
+
+    toks, cache = _decode_scan(
+        params, cache, tok, start + suffix_len, rng, temperature, top_k,
+        cfg, family, max_new_tokens,
+    )
+    if return_cache:
+        return toks, cache["k"], cache["v"]
+    return toks
 
 
 def _ffn_block(layer: dict, x, cfg: dict, family: str, dtype):
@@ -218,13 +290,15 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     rng=None,
+    return_cache: bool = False,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` per row of ``input_ids`` (B, S prompt,
     right-padded to a common S; ``prompt_lengths`` gives true lengths).
 
     Decoder-LM families sharing the transformer_lm attention/cache layout
     are supported (transformer_lm, moe_lm). Returns (B, max_new_tokens)
-    int32 token ids.
+    int32 token ids; with ``return_cache`` also the final KV arrays (the
+    prefix cache stores them for reuse).
     """
     if model_def.family not in ("transformer_lm", "moe_lm"):
         raise ValueError(
@@ -254,4 +328,46 @@ def generate(
         cfg_key=cfg_key,
         max_new_tokens=max_new_tokens,
         family=model_def.family,
+        return_cache=return_cache,
+    )
+
+
+def generate_from_cache(
+    model_def: Any,
+    params: Any,
+    suffix_ids,
+    suffix_len: int,
+    cached_k,
+    cached_v,
+    cached_len: int,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng=None,
+    return_cache: bool = False,
+):
+    """Continue a (B=1) generate from a cached prompt-prefix KV (the prefix
+    cache's fast path — runtime/prefix_cache.py). ``suffix_ids`` (1, S') are
+    the prompt tokens after the cached prefix, padded; ``cached_len`` is the
+    number of valid rows in the padded ``cached_k/v``."""
+    import jax
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cfg = model_def.config
+    cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
+    return _generate_from_cache_jit(
+        params,
+        jnp.asarray(suffix_ids, jnp.int32),
+        jnp.asarray([suffix_len], jnp.int32),
+        cached_k,
+        cached_v,
+        jnp.asarray([cached_len], jnp.int32),
+        rng,
+        jnp.asarray(temperature, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        cfg_key=cfg_key,
+        max_new_tokens=max_new_tokens,
+        family=model_def.family,
+        return_cache=return_cache,
     )

@@ -223,6 +223,14 @@ class TPUModelRuntime(BaseRuntime):
         )
         self._load_locks: dict[ModelId, threading.Lock] = {}
         self._load_locks_guard = threading.Lock()
+        # prefix KV cache (OFF unless budgeted): single-group runtimes only —
+        # on a cross-host group the leader's hit and a follower's miss would
+        # run DIFFERENT programs and wedge the collective
+        self._prefix_cache = None
+        if self.cfg.prefix_cache_bytes > 0 and mesh is None:
+            from tfservingcache_tpu.runtime.prefix_cache import PrefixCache
+
+            self._prefix_cache = PrefixCache(self.cfg.prefix_cache_bytes)
         # One jitted apply per (family, config) build key: all tenants of a
         # family share one XLA executable — tenant N's cold load is
         # params-transfer only. Entries are refcounted by resident models and
@@ -616,16 +624,23 @@ class TPUModelRuntime(BaseRuntime):
                     spec_tokens=spec_tokens,
                 )
             else:
-                toks = gen(
-                    loaded.model_def,
-                    loaded.params,
-                    ids,
-                    prompt_lengths=lengths,
-                    max_new_tokens=new_bucket,
-                    temperature=temperature,
-                    top_k=top_k,
-                    rng=jax.random.PRNGKey(seed),
-                )
+                toks = None
+                if self._prefix_cache is not None and ids.shape[0] == 1:
+                    toks = self._prefix_generate(
+                        loaded, model_id, ids, int(lengths[0]), new_bucket,
+                        max_new_tokens, temperature, top_k, seed,
+                    )
+                if toks is None:
+                    toks = gen(
+                        loaded.model_def,
+                        loaded.params,
+                        ids,
+                        prompt_lengths=lengths,
+                        max_new_tokens=new_bucket,
+                        temperature=temperature,
+                        top_k=top_k,
+                        rng=jax.random.PRNGKey(seed),
+                    )
             if self._mp_mesh:
                 # force the token array fully replicated so this process can
                 # read it (inferred output sharding may split it across hosts);
@@ -646,6 +661,9 @@ class TPUModelRuntime(BaseRuntime):
     # -- unload / introspection --------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
         self._set_state(model_id, ModelState.UNLOADING)
+        if self._prefix_cache is not None:
+            # an unloaded model's prefix KV must not outlive it in HBM
+            self._prefix_cache.drop_model(model_id)
         # Only the LRU's reference is dropped; in-flight predicts holding the
         # LoadedModel keep the device arrays alive until they finish, then XLA
         # frees the HBM when the last reference goes. (Nulling the fields here
@@ -677,6 +695,71 @@ class TPUModelRuntime(BaseRuntime):
 
     def is_loaded(self, model_id: ModelId) -> bool:
         return self._resident.get(model_id, touch=False) is not None
+
+    def _prefix_generate(self, loaded, model_id, ids, prompt_len: int,
+                         new_bucket: int, max_new: int, temperature: float,
+                         top_k: int, seed: int):
+        """B=1 generate through the prefix KV cache: reuse the longest
+        cached token-prefix's K/V rows, prefill only the suffix, and store
+        the (prompt + completion) rows for the next turn. Output is
+        identical to the plain path — same math at the same positions, and
+        the decode scan's rng split structure is shared."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import (
+            generate as gen,
+            generate_from_cache,
+        )
+
+        pc = self._prefix_cache
+        prompt = ids[0, :prompt_len]
+        rng = jax.random.PRNGKey(seed)
+        hit = pc.lookup(model_id, prompt)
+        if hit is None:
+            toks_d, k_full, v_full = gen(
+                loaded.model_def, loaded.params, ids,
+                prompt_lengths=np.array([prompt_len], np.int32),
+                max_new_tokens=new_bucket, temperature=temperature,
+                top_k=top_k, rng=rng, return_cache=True,
+            )
+        else:
+            l_use = hit.valid_len
+            suffix = ids[:1, l_use:prompt_len]
+            suffix_len = prompt_len - l_use
+            s_pad = next_bucket(suffix_len)
+            if s_pad != suffix.shape[1]:
+                suffix = np.pad(suffix, ((0, 0), (0, s_pad - suffix.shape[1])))
+            toks_d, k_full, v_full = generate_from_cache(
+                loaded.model_def, loaded.params, suffix, suffix_len,
+                hit.k, hit.v, l_use, max_new_tokens=new_bucket,
+                temperature=temperature, top_k=top_k, rng=rng,
+                return_cache=True,
+            )
+        toks = np.asarray(jax.device_get(toks_d))
+        # every emitted token's K/V row was written (the scan forwards the
+        # carry token before sampling the next), so rows are valid through
+        # prompt_len + new_bucket — but the entry must stop at the TRUE
+        # max_new: the bucket-padding generations were never returned to the
+        # client, so the next turn's prompt diverges exactly there and an
+        # entry containing them would never match again (review repro:
+        # max_new=5 bucketed to 8 made every conversation a permanent miss)
+        valid = prompt_len + max_new
+        entry_tokens = np.concatenate([prompt, toks[0, :max_new]])
+        # store at the power-of-two FLOOR of the valid rows: only pow2 row
+        # blocks may be cached (an odd width would mint a novel jit trace
+        # shape on every later hit), the floor always fits the cache array,
+        # and the tail it drops is at most half — the next turn still
+        # reuses the bulk of the history
+        l_store = 1 << (valid.bit_length() - 1)
+        if l_store >= 16:
+            pc.insert(
+                model_id, entry_tokens[:l_store],
+                k_full[:, :, :, :l_store, :], v_full[:, :, :, :l_store, :],
+                l_store,
+            )
+        TRACER.annotate(prefix_hit=hit is not None,
+                        prefix_rows=0 if hit is None else hit.valid_len)
+        return toks
 
     def resident_headroom(self) -> tuple[int | None, int]:
         """(free resident model slots or None if uncapped, free HBM bytes).

@@ -1,0 +1,125 @@
+"""Prefix KV cache: reuse a prompt's (and its generation's) device-resident
+K/V rows across ``:generate`` requests.
+
+No reference counterpart (the reference proxies opaque Predicts). The
+serving pattern this targets is conversational: turn N's prompt extends
+turn N-1's prompt + completion, so the expensive prefill over the shared
+history is paid once. Entries store the PADDED cache block (power-of-two
+row bucket — one jitted copy shape per bucket) plus the exact token ids
+those rows encode; a lookup matches the longest cached entry whose tokens
+are a prefix of the new prompt, token-for-token (no hash-collision risk).
+
+Byte-budgeted LRU, OFF by default (``serving.prefix_cache_bytes = 0``):
+entries hold real HBM. Single-group runtimes only — a cross-host group's
+leader and followers could disagree on hits and diverge their op streams.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from tfservingcache_tpu.types import ModelId
+
+
+@dataclass
+class PrefixEntry:
+    tokens: np.ndarray          # (L,) int32 — what the valid rows encode
+    k: Any                      # (layers, 1, n_kv, Lpad, hd) device array
+    v: Any
+    valid_len: int              # L <= Lpad
+    nbytes: int
+
+
+def _bucket(n: int) -> int:
+    """Power-of-two row bucket with a 16-row floor (one jitted copy shape
+    per bucket); shares the runtime's next_bucket rather than re-coding it."""
+    from tfservingcache_tpu.runtime.model_runtime import next_bucket
+
+    return max(16, next_bucket(n))
+
+
+class PrefixCache:
+    def __init__(self, capacity_bytes: int) -> None:
+        self.capacity_bytes = int(capacity_bytes)
+        self._lock = threading.Lock()
+        # LRU: key -> entry; key includes the model and the entry's token
+        # bytes (exact, not a hash)
+        self._entries: OrderedDict[tuple, PrefixEntry] = OrderedDict()
+        self._total = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(model_id: ModelId, tokens: np.ndarray) -> tuple:
+        return (model_id, tokens.tobytes())
+
+    def lookup(self, model_id: ModelId, prompt: np.ndarray) -> PrefixEntry | None:
+        """Longest entry whose tokens are a strict prefix of ``prompt``
+        (strict: at least one suffix token must remain to prefill — the
+        forward needs a non-empty block)."""
+        prompt = np.asarray(prompt, np.int32)
+        best: PrefixEntry | None = None
+        best_key: tuple | None = None
+        with self._lock:
+            for key, ent in self._entries.items():
+                if key[0] != model_id:
+                    continue
+                usable = min(ent.valid_len, prompt.shape[0] - 1)
+                if usable < 1 or (best is not None and usable <= best.valid_len):
+                    continue
+                if np.array_equal(ent.tokens[:usable], prompt[:usable]):
+                    if usable < ent.valid_len:
+                        # partially usable entry: present it at the usable
+                        # length (rows beyond it are junk the suffix prefill
+                        # overwrites)
+                        ent = PrefixEntry(ent.tokens[:usable], ent.k, ent.v,
+                                          usable, ent.nbytes)
+                    best = ent
+                    best_key = key  # the BACKING key — a truncated view's
+                    #                 rebuilt key would never match it
+            if best is not None:
+                self._entries.move_to_end(best_key)  # LRU recency touch
+                self.hits += 1
+            else:
+                self.misses += 1
+        return best
+
+    def insert(self, model_id: ModelId, tokens: np.ndarray, k, v,
+               valid_len: int) -> None:
+        tokens = np.asarray(tokens, np.int32)[:valid_len]
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        if nbytes > self.capacity_bytes:
+            return  # one entry over budget: don't thrash the whole cache
+        key = self._key(model_id, tokens)
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._total -= old.nbytes
+            while self._total + nbytes > self.capacity_bytes and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._total -= evicted.nbytes
+            self._entries[key] = PrefixEntry(tokens, k, v, valid_len, nbytes)
+            self._total += nbytes
+
+    def drop_model(self, model_id: ModelId) -> None:
+        """Model unloaded/evicted: its prefix KV must go with it."""
+        with self._lock:
+            for key in [k for k in self._entries if k[0] == model_id]:
+                self._total -= self._entries.pop(key).nbytes
+
+    @property
+    def total_bytes(self) -> int:
+        return self._total
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._total = 0
