@@ -11,7 +11,7 @@ from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
 from tfservingcache_tpu.config import ServingConfig
 from tfservingcache_tpu.models.registry import export_artifact
 from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
-from tfservingcache_tpu.runtime.prefix_cache import PrefixCache, _bucket
+from tfservingcache_tpu.runtime.prefix_cache import PrefixCache
 from tfservingcache_tpu.types import ModelId
 
 CFG = {
@@ -146,7 +146,3 @@ def test_batched_requests_skip_prefix_path(stacks):
     out = rt.generate(mid, prompts, max_new_tokens=4)
     assert out.shape == (3, 4)
     assert len(rt._prefix_cache) == 0  # B>1 never touches the cache
-
-
-def test_bucket_helper():
-    assert _bucket(1) == 16 and _bucket(16) == 16 and _bucket(17) == 32

@@ -759,6 +759,10 @@ class TPUModelRuntime(BaseRuntime):
             )
         TRACER.annotate(prefix_hit=hit is not None,
                         prefix_rows=0 if hit is None else hit.valid_len)
+        if self.metrics is not None:
+            (self.metrics.prefix_cache_hits if hit is not None
+             else self.metrics.prefix_cache_misses).inc()
+            self.metrics.prefix_cache_bytes.set(pc.total_bytes)
         return toks
 
     def resident_headroom(self) -> tuple[int | None, int]:

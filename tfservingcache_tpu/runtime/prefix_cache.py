@@ -4,14 +4,17 @@ K/V rows across ``:generate`` requests.
 No reference counterpart (the reference proxies opaque Predicts). The
 serving pattern this targets is conversational: turn N's prompt extends
 turn N-1's prompt + completion, so the expensive prefill over the shared
-history is paid once. Entries store the PADDED cache block (power-of-two
-row bucket — one jitted copy shape per bucket) plus the exact token ids
-those rows encode; a lookup matches the longest cached entry whose tokens
-are a prefix of the new prompt, token-for-token (no hash-collision risk).
+history is paid once. Entries store a power-of-two row block — the CALLER
+(_prefix_generate) slices to the pow2 floor of the valid rows, so hits
+never mint novel jit trace shapes — plus the exact token ids those rows
+encode; a lookup matches the longest cached entry whose tokens are a
+prefix of the new prompt, token-for-token (no hash-collision risk).
 
 Byte-budgeted LRU, OFF by default (``serving.prefix_cache_bytes = 0``):
 entries hold real HBM. Single-group runtimes only — a cross-host group's
 leader and followers could disagree on hits and diverge their op streams.
+Entries are bucketed per model so one tenant's scan never pays for
+another's, and ``drop_model`` is O(that model's entries).
 """
 
 from __future__ import annotations
@@ -35,28 +38,17 @@ class PrefixEntry:
     nbytes: int
 
 
-def _bucket(n: int) -> int:
-    """Power-of-two row bucket with a 16-row floor (one jitted copy shape
-    per bucket); shares the runtime's next_bucket rather than re-coding it."""
-    from tfservingcache_tpu.runtime.model_runtime import next_bucket
-
-    return max(16, next_bucket(n))
-
-
 class PrefixCache:
     def __init__(self, capacity_bytes: int) -> None:
         self.capacity_bytes = int(capacity_bytes)
         self._lock = threading.Lock()
-        # LRU: key -> entry; key includes the model and the entry's token
-        # bytes (exact, not a hash)
-        self._entries: OrderedDict[tuple, PrefixEntry] = OrderedDict()
+        # per-model LRU of entries (token bytes -> entry), with a global
+        # recency order across models for byte-budget eviction
+        self._by_model: dict[ModelId, OrderedDict[bytes, PrefixEntry]] = {}
+        self._recency: OrderedDict[tuple[ModelId, bytes], None] = OrderedDict()
         self._total = 0
         self.hits = 0
         self.misses = 0
-
-    @staticmethod
-    def _key(model_id: ModelId, tokens: np.ndarray) -> tuple:
-        return (model_id, tokens.tobytes())
 
     def lookup(self, model_id: ModelId, prompt: np.ndarray) -> PrefixEntry | None:
         """Longest entry whose tokens are a strict prefix of ``prompt``
@@ -64,11 +56,9 @@ class PrefixCache:
         forward needs a non-empty block)."""
         prompt = np.asarray(prompt, np.int32)
         best: PrefixEntry | None = None
-        best_key: tuple | None = None
+        best_tok: bytes | None = None
         with self._lock:
-            for key, ent in self._entries.items():
-                if key[0] != model_id:
-                    continue
+            for tok_bytes, ent in self._by_model.get(model_id, {}).items():
                 usable = min(ent.valid_len, prompt.shape[0] - 1)
                 if usable < 1 or (best is not None and usable <= best.valid_len):
                     continue
@@ -80,10 +70,9 @@ class PrefixCache:
                         ent = PrefixEntry(ent.tokens[:usable], ent.k, ent.v,
                                           usable, ent.nbytes)
                     best = ent
-                    best_key = key  # the BACKING key — a truncated view's
-                    #                 rebuilt key would never match it
+                    best_tok = tok_bytes  # the BACKING key, not the view's
             if best is not None:
-                self._entries.move_to_end(best_key)  # LRU recency touch
+                self._recency.move_to_end((model_id, best_tok))
                 self.hits += 1
             else:
                 self.misses += 1
@@ -95,31 +84,42 @@ class PrefixCache:
         nbytes = int(k.nbytes) + int(v.nbytes)
         if nbytes > self.capacity_bytes:
             return  # one entry over budget: don't thrash the whole cache
-        key = self._key(model_id, tokens)
+        tok_bytes = tokens.tobytes()
         with self._lock:
-            old = self._entries.pop(key, None)
+            model_entries = self._by_model.setdefault(model_id, OrderedDict())
+            old = model_entries.pop(tok_bytes, None)
             if old is not None:
                 self._total -= old.nbytes
-            while self._total + nbytes > self.capacity_bytes and self._entries:
-                _, evicted = self._entries.popitem(last=False)
-                self._total -= evicted.nbytes
-            self._entries[key] = PrefixEntry(tokens, k, v, valid_len, nbytes)
+                self._recency.pop((model_id, tok_bytes), None)
+            while self._total + nbytes > self.capacity_bytes and self._recency:
+                (ev_mid, ev_tok), _ = self._recency.popitem(last=False)
+                ev = self._by_model.get(ev_mid, {}).pop(ev_tok, None)
+                if ev is not None:
+                    self._total -= ev.nbytes
+            model_entries[tok_bytes] = PrefixEntry(tokens, k, v, valid_len,
+                                                   nbytes)
+            self._recency[(model_id, tok_bytes)] = None
             self._total += nbytes
 
     def drop_model(self, model_id: ModelId) -> None:
         """Model unloaded/evicted: its prefix KV must go with it."""
         with self._lock:
-            for key in [k for k in self._entries if k[0] == model_id]:
-                self._total -= self._entries.pop(key).nbytes
+            entries = self._by_model.pop(model_id, None)
+            if not entries:
+                return
+            for tok_bytes, ent in entries.items():
+                self._total -= ent.nbytes
+                self._recency.pop((model_id, tok_bytes), None)
 
     @property
     def total_bytes(self) -> int:
         return self._total
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return sum(len(d) for d in self._by_model.values())
 
     def clear(self) -> None:
         with self._lock:
-            self._entries.clear()
+            self._by_model.clear()
+            self._recency.clear()
             self._total = 0

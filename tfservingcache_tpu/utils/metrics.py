@@ -105,6 +105,21 @@ class Metrics:
             "Models pre-loaded by the ring-assignment warmer",
             registry=r,
         )
+        self.prefix_cache_hits = Counter(
+            "tpusc_prefix_cache_hits_total",
+            "generate requests that reused a cached prompt-prefix KV",
+            registry=r,
+        )
+        self.prefix_cache_misses = Counter(
+            "tpusc_prefix_cache_misses_total",
+            "generate requests that paid full prefill (prefix cache on)",
+            registry=r,
+        )
+        self.prefix_cache_bytes = Gauge(
+            "tpusc_prefix_cache_bytes",
+            "Device bytes held by cached prompt-prefix KV entries",
+            registry=r,
+        )
 
     def model_label(self, name: str, version: int | str) -> str:
         if self.model_labels:

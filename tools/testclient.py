@@ -30,9 +30,12 @@ PREDICTION = "tensorflow.serving.PredictionService"
 MODEL = "tensorflow.serving.ModelService"
 
 
-def model_spec(name: str, version: int | None) -> sv.ModelSpec:
+def model_spec(name: str, version: int | None,
+               label: str | None = None) -> sv.ModelSpec:
     spec = sv.ModelSpec(name=name)
-    if version is not None:
+    if label is not None:
+        spec.version_label = label
+    elif version is not None:
         spec.version.value = version
     return spec
 
@@ -41,7 +44,13 @@ async def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--target", default="localhost:8100")
     p.add_argument("--model", required=True)
-    p.add_argument("--version", type=int, default=None)
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-request deadline seconds (cold loads compile)")
+    vg = p.add_mutually_exclusive_group()
+    vg.add_argument("--version", type=int, default=None)
+    vg.add_argument("--label", default=None,
+                    help="ModelSpec.version_label (resolved via "
+                         "serving.version_labels)")
     g = p.add_mutually_exclusive_group(required=True)
     g.add_argument("--predict", metavar="JSON", help='inputs, e.g. \'{"x": [[1.0]]}\'')
     g.add_argument("--classify", action="store_true", help="empty-example Classify (reference testclient flow)")
@@ -59,25 +68,27 @@ async def main() -> int:
         import urllib.request
 
         url = f"http://{args.target}/v1/models/{args.model}"
-        if args.version is not None:
+        if args.label is not None:
+            url += f"/labels/{args.label}"
+        elif args.version is not None:
             url += f"/versions/{args.version}"
         req = urllib.request.Request(
             url + ":generate", data=args.generate.encode(),
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=120) as resp:
+        with urllib.request.urlopen(req, timeout=args.timeout) as resp:
             print(resp.read().decode())
         return 0
 
     channel = make_channel(args.target)
     stub = ServingStub(channel)
-    spec = model_spec(args.model, args.version)
+    spec = model_spec(args.model, args.version, args.label)
     try:
         if args.predict:
             req = sv.PredictRequest(model_spec=spec)
             for name, value in json.loads(args.predict).items():
                 req.inputs[name].CopyFrom(codec.numpy_to_tensorproto(np.asarray(value)))
-            resp = await stub.method(PREDICTION, "Predict")(req, timeout=30)
+            resp = await stub.method(PREDICTION, "Predict")(req, timeout=args.timeout)
             out = {k: codec.tensorproto_to_numpy(v).tolist() for k, v in resp.outputs.items()}
             print(json.dumps({"outputs": out}))
         elif args.classify:
@@ -87,15 +98,15 @@ async def main() -> int:
                 model_spec=spec,
                 input=sv.Input(example_list=sv.ExampleList(examples=[core.Example()])),
             )
-            resp = await stub.method(PREDICTION, "Classify")(req, timeout=30)
+            resp = await stub.method(PREDICTION, "Classify")(req, timeout=args.timeout)
             print(resp)
         elif args.status:
             req = sv.GetModelStatusRequest(model_spec=spec)
-            resp = await stub.method(MODEL, "GetModelStatus")(req, timeout=10)
+            resp = await stub.method(MODEL, "GetModelStatus")(req, timeout=args.timeout)
             print(resp)
         else:
             req = sv.GetModelMetadataRequest(model_spec=spec, metadata_field=["signature_def"])
-            resp = await stub.method(PREDICTION, "GetModelMetadata")(req, timeout=10)
+            resp = await stub.method(PREDICTION, "GetModelMetadata")(req, timeout=args.timeout)
             print(resp)
     finally:
         await channel.close()
