@@ -55,6 +55,32 @@ def test_prefix_cache_lru_budget_and_lookup():
     assert len(pc) == 0 and pc.total_bytes == 0
 
 
+def test_prefix_cache_per_model_entry_cap():
+    """lookup() scans one model's entries under the lock, so entries-per-model
+    is capped (_MAX_ENTRIES_PER_MODEL) regardless of byte budget; the model's
+    own LRU end is evicted, and a looked-up entry counts as recently used."""
+    from tfservingcache_tpu.runtime.prefix_cache import _MAX_ENTRIES_PER_MODEL
+
+    pc = PrefixCache(capacity_bytes=1 << 30)
+    mid = ModelId("m", 1)
+    cap = _MAX_ENTRIES_PER_MODEL
+    for i in range(cap):
+        toks = np.full(4, i, np.int32)
+        pc.insert(mid, toks, _Arr(8), _Arr(8), 4)
+    assert len(pc) == cap
+    # touch entry 0 so it is MRU within the model
+    assert pc.lookup(mid, np.full(6, 0, np.int32)) is not None
+    pc.insert(mid, np.full(4, cap, np.int32), _Arr(8), _Arr(8), 4)
+    assert len(pc) == cap
+    # entry 1 (the oldest untouched) was evicted; 0 survived its touch
+    assert pc.lookup(mid, np.full(6, 1, np.int32)) is None
+    assert pc.lookup(mid, np.full(6, 0, np.int32)) is not None
+    assert pc.total_bytes == cap * 16
+    # other models are unaffected by one model's cap
+    pc.insert(ModelId("n", 1), np.arange(4, dtype=np.int32), _Arr(8), _Arr(8), 4)
+    assert len(pc) == cap + 1
+
+
 @pytest.fixture
 def stacks(tmp_path):
     def make(prefix_bytes):

@@ -62,6 +62,26 @@ def test_int8_artifact_smaller_and_roundtrips(tmp_path):
         assert float(np.max(np.abs(a32 - b32))) / denom < 0.02
 
 
+def test_resident_estimate_exceeds_int8_disk_size(tmp_path):
+    """Capacity planners must budget an int8 artifact at its DEQUANTIZED
+    device size, not its disk size (ADVICE r4: the warmer's headroom check
+    previously used disk bytes and could overshoot free HBM)."""
+    from tfservingcache_tpu.models.registry import resident_bytes_estimate
+
+    plain = export_artifact("transformer_lm", str(tmp_path / "plain"),
+                            name="m", version=1, seed=0, config=LM_CFG)
+    quant = export_artifact("transformer_lm", str(tmp_path / "quant"),
+                            name="m", version=1, seed=0, config=LM_CFG,
+                            quantize="int8")
+    est_plain = resident_bytes_estimate(plain)
+    est_quant = resident_bytes_estimate(quant)
+    # same params => same resident footprint, regardless of transport encoding
+    assert est_plain == est_quant
+    quant_disk = os.path.getsize(os.path.join(quant, "params.bin"))
+    assert est_quant > 1.4 * quant_disk, (est_quant, quant_disk)
+    assert resident_bytes_estimate(str(tmp_path)) is None  # not an artifact
+
+
 def test_int8_raw_quant_returns_quantleaves(tmp_path):
     quant = export_artifact("transformer_lm", str(tmp_path / "q"),
                             name="m", version=1, seed=0, config=LM_CFG,

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import threading
 
+from tfservingcache_tpu.models.registry import resident_bytes_estimate
 from tfservingcache_tpu.utils.logging import get_logger
 
 log = get_logger("cluster.warmer")
@@ -83,7 +84,8 @@ class AssignmentWarmer:
                 # ensure_servable down the MISS path — a provider fetch this
                 # policy promises not to make (a remaining hairline race is
                 # acceptable: warming is advisory)
-                if manager.disk_cache.get(mid) is None:
+                cached = manager.disk_cache.get(mid)
+                if cached is None:
                     continue
                 # bound the sweep by free resident capacity: when a node
                 # owns more cached models than fit in HBM (the multi-tenant
@@ -94,7 +96,13 @@ class AssignmentWarmer:
                 headroom = getattr(runtime, "resident_headroom", None)
                 if headroom is not None and not runtime.is_loaded(mid):
                     free_slots, free_bytes = headroom()
-                    est = manager.disk_cache.size_of(mid) or 0
+                    # device bytes, not disk bytes: an int8 artifact
+                    # dequantizes on device to 2-4x its disk size (ADVICE r4)
+                    est = (
+                        resident_bytes_estimate(cached.path)
+                        or manager.disk_cache.size_of(mid)
+                        or 0
+                    )
                     if (free_slots is not None and free_slots <= 0) or (
                         est > free_bytes
                     ):

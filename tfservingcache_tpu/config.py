@@ -39,10 +39,15 @@ class ServingConfig:
     platform: str = ""                     # "" = default jax backend; "cpu" forces CPU
     # adaptive micro-batching (TF Serving --enable_batching equivalent,
     # in-process now): 0 disables; concurrent same-shape requests within the
-    # window coalesce into one device call. Default 2 ms: well under a cold
-    # client's perception, long enough to coalesce concurrent warm traffic
-    # into one MXU dispatch (bench.py records QPS batcher on vs off).
-    batch_window_ms: float = 2.0
+    # window coalesce into one device call. Default 0 (OFF): every
+    # measurement taken so far favors it — the only TPU datum (BENCH_r02:
+    # batching cost 31% REST QPS on mnist) and the CPU LM REST rows
+    # (BENCH_r04: 45.9 QPS batched vs 57.8 unbatched). Enable per-deployment
+    # (set 1-2 ms) only when profiling shows concurrent same-shape warm
+    # traffic whose batched device call beats the window latency — e.g.
+    # many-client gRPC fan-in on one large model (bench.py `batcher_qps`
+    # section measures exactly this pair).
+    batch_window_ms: float = 0.0
     batch_max_size: int = 64
     # Prefix KV cache for :generate (runtime/prefix_cache.py): byte budget
     # of device memory for reusable prompt-prefix K/V. 0 = off (default —

@@ -463,6 +463,32 @@ def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
     return model, _restore_lists(nested)
 
 
+def resident_bytes_estimate(path: str) -> int | None:
+    """Estimated DEVICE bytes of the artifact's params once servable (None
+    if unreadable). For plain artifacts this matches the on-disk param bytes;
+    for int8-quantized artifacts each quant leaf dequantizes on device to
+    ``orig_dtype`` (2-4x its disk size), so capacity planners (the assignment
+    warmer's headroom check) must use this, not disk bytes (ADVICE r4)."""
+    try:
+        import ml_dtypes  # registers bfloat16/float8 names with np.dtype
+
+        del ml_dtypes
+        with open(os.path.join(path, MODEL_JSON)) as f:
+            meta = json.load(f)
+        manifest = (meta.get("params") or {}).get("manifest")
+        if manifest is None:
+            return None
+        total = 0
+        for ent in manifest:
+            n = int(np.prod(ent["shape"])) if ent["shape"] else 1
+            quant = ent.get("quant")
+            dt = np.dtype(quant["orig_dtype"] if quant else ent["dtype"])
+            total += n * dt.itemsize
+        return total
+    except Exception:  # noqa: BLE001 - estimate only; callers fall back
+        return None
+
+
 def _restore_lists(tree: Any) -> Any:
     """flax msgpack round-trips Python lists as {"0": ..., "1": ...} dicts;
     convert them back so families can keep natural list-of-layers params."""

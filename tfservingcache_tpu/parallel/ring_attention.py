@@ -139,7 +139,8 @@ def ring_attention(
         in_specs=(spec, spec, spec),
         out_specs=spec,
         # pallas_call out_shapes carry no varying-mesh-axes metadata, which
-        # the flash body trips over; in/out specs above are explicit
-        check_vma=False,
+        # the flash body trips over; in/out specs above are explicit. The
+        # einsum path keeps shard_map's validation (ADVICE r4).
+        check_vma=(impl != "flash"),
     )
     return fn(q, k, v)

@@ -701,9 +701,13 @@ class TPUModelRuntime(BaseRuntime):
                          top_k: int, seed: int):
         """B=1 generate through the prefix KV cache: reuse the longest
         cached token-prefix's K/V rows, prefill only the suffix, and store
-        the (prompt + completion) rows for the next turn. Output is
-        identical to the plain path — same math at the same positions, and
-        the decode scan's rng split structure is shared."""
+        the (prompt + completion) rows for the next turn. Output matches the
+        plain path in exact arithmetic — same math at the same positions,
+        shared decode-scan rng split structure — but the hit path's
+        suffix-only prefill is a different matmul shape, so near-tied
+        argmax/sampling under accelerator float reassociation can differ
+        between hit and miss (same caveat as models/speculative.py); don't
+        rely on seed-reproducibility across cache state."""
         import jax
 
         from tfservingcache_tpu.models.generation import (

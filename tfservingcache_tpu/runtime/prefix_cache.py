@@ -38,6 +38,13 @@ class PrefixEntry:
     nbytes: int
 
 
+# lookup() linear-scans one model's entries under the global lock; this cap
+# keeps the B=1 :generate hot path O(small) no matter how large the byte
+# budget is (ADVICE r4). 32 concurrent conversations per tenant model before
+# the model's own LRU starts dropping the coldest thread.
+_MAX_ENTRIES_PER_MODEL = 32
+
+
 class PrefixCache:
     def __init__(self, capacity_bytes: int) -> None:
         self.capacity_bytes = int(capacity_bytes)
@@ -73,6 +80,9 @@ class PrefixCache:
                     best_tok = tok_bytes  # the BACKING key, not the view's
             if best is not None:
                 self._recency.move_to_end((model_id, best_tok))
+                # keep the per-model order LRU too: the entry cap below
+                # evicts from its front
+                self._by_model[model_id].move_to_end(best_tok)
                 self.hits += 1
             else:
                 self.misses += 1
@@ -100,6 +110,10 @@ class PrefixCache:
                                                    nbytes)
             self._recency[(model_id, tok_bytes)] = None
             self._total += nbytes
+            while len(model_entries) > _MAX_ENTRIES_PER_MODEL:
+                ev_tok, ev = model_entries.popitem(last=False)
+                self._total -= ev.nbytes
+                self._recency.pop((model_id, ev_tok), None)
 
     def drop_model(self, model_id: ModelId) -> None:
         """Model unloaded/evicted: its prefix KV must go with it."""
