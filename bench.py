@@ -161,7 +161,7 @@ def _peak_flops(device_kind: str) -> float | None:
 
 def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
                 config: dict | None = None, resident_cap: int | None = None,
-                quantize: str | None = None):
+                quantize: str | None = None, prefix_cache_bytes: int = 0):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
@@ -181,6 +181,7 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
         ServingConfig(
             hbm_capacity_bytes=hbm_gb << 30,
             max_concurrent_models=resident_cap or max(tenants, 4),
+            prefix_cache_bytes=prefix_cache_bytes,
             # the A4 persistent compile cache, at a path that survives runs:
             # a restarted node re-hits its compiles instead of recompiling
             # the world (SURVEY §7 hard part (a) calls this load-bearing for
@@ -231,7 +232,8 @@ def _section(name: str):
 # (the stack it measures is built there).
 SECTION_GROUPS = (
     "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
-    "mnist_qps", "routed", "lm_throughput", "lm_qps", "tenant_soak",
+    "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
+    "prefix_gen", "tenant_soak",
 )
 
 
@@ -778,10 +780,12 @@ def bench_flash_kernel() -> dict:
     return results
 
 
-def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dict:
-    """Scaled-down 1000-tenant scenario on the real chip: HBM cap forces
-    churn, zipfian stream measures hit-rate + churned-request latency
-    (tests/test_soak.py runs the full 1000 on the CPU harness)."""
+def bench_tenant_soak(tmp: str, tenants: int = 1000, requests: int = 3000) -> dict:
+    """The BASELINE.md north-star scenario at FULL scale: 1000 per-tenant
+    models under a 16-slot HBM cap (VERDICT r5 #3 — round 4 ran 200). The
+    zipfian stream measures hit-rate, churned-request latency, and eviction
+    churn; the cold sweep is reported separately (it is 1000 sequential
+    first-loads, the reference's README.md:15 motivating case)."""
     import numpy as np
 
     from tfservingcache_tpu.types import ModelId
@@ -789,10 +793,12 @@ def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dic
     manager, runtime = _make_stack("half_plus_two", tenants, tmp, resident_cap=16)
     rng = np.random.default_rng(0)
     xs = [{"x": rng.normal(size=(4,)).astype(np.float32)} for _ in range(16)]
+    t_sweep = time.perf_counter()
     for i in range(tenants):  # cold sweep
         mid = ModelId(f"tenant{i}", 1)
         manager.ensure_servable(mid)
         runtime.predict(mid, xs[i % len(xs)])
+    sweep_s = time.perf_counter() - t_sweep
     ranks = np.minimum(rng.zipf(1.3, size=requests), tenants) - 1
     lat = []
     hits = 0
@@ -811,9 +817,232 @@ def bench_tenant_soak(tmp: str, tenants: int = 200, requests: int = 1000) -> dic
         "requests": requests,
         "resident_cap": 16,
         "hbm_hit_rate": round(hits / requests, 3),
+        # every miss in the stream evicted one resident model to make room
+        # (the cap stays full after the sweep): churn = reload count
+        "eviction_churn_reloads": requests - hits,
+        "cold_sweep_s": round(sweep_s, 1),
+        "cold_sweep_per_tenant_ms": round(sweep_s / tenants * 1e3, 2),
         "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
         "p95_ms": round(lat[int(0.95 * (len(lat) - 1))] * 1e3, 3),
     }
+
+
+def bench_spec_decode(tmp: str, lm_config: dict) -> dict:
+    """Does speculative decoding HELP? (VERDICT r5 #4a — the feature shipped
+    in round 4 with exactness tests but zero throughput rows.)
+
+    B=1 greedy ``:generate`` tokens/s: plain decode vs a draft at
+    spec_tokens 2/4/8, plus the acceptance signal (emitted tokens per verify
+    round; spec_tokens+1 = perfect). Two drafts price the envelope:
+    ``early_exit`` shares the target's embed + first quarter of its layers
+    (the realistic deployment: cheap and correlated), ``tiny`` is an
+    independent random model (acceptance floor — the worst case task #6's
+    auto-disable exists for). Runs through runtime.generate — both arms pay
+    identical protocol cost, so the delta is the feature's."""
+    import numpy as np
+
+    from tfservingcache_tpu.models.registry import build, save_artifact
+    from tfservingcache_tpu.models.speculative import speculative_generate
+    from tfservingcache_tpu.types import ModelId
+
+    manager, runtime = _make_stack("transformer_lm", 1, tmp,
+                                   config=lm_config)
+    store = os.path.join(tmp, "store-transformer_lm")
+    target_mid = ModelId("tenant0", 1)
+    manager.ensure_servable(target_mid)
+    loaded = runtime._resident.get(target_mid)
+
+    # early-exit draft: embed/ln_f shared, first quarter of the layers
+    d_layers = max(1, lm_config["n_layers"] // 4)
+    draft_cfg = dict(lm_config, n_layers=d_layers)
+    draft_def = build("transformer_lm", draft_cfg)
+    draft_params = {
+        "embed": loaded.params["embed"],
+        "ln_f": loaded.params["ln_f"],
+        "layers": [dict(l) for l in loaded.params["layers"][:d_layers]],
+    }
+    save_artifact(os.path.join(store, "draft_exit", "1"), draft_def,
+                  draft_params)
+    # tiny independent draft: same vocab, quarter width, fresh weights
+    tiny_cfg = dict(
+        lm_config, d_model=max(64, lm_config["d_model"] // 4),
+        n_layers=max(1, lm_config["n_layers"] // 4),
+        d_ff=max(128, lm_config["d_ff"] // 4),
+        n_heads=max(2, lm_config["n_heads"] // 4),
+        n_kv_heads=max(1, lm_config["n_kv_heads"] // 4),
+    )
+    from tfservingcache_tpu.models.registry import export_artifact
+
+    export_artifact("transformer_lm", store, name="draft_tiny", version=1,
+                    seed=99, config=tiny_cfg)
+    for name in ("draft_exit", "draft_tiny"):
+        manager.ensure_servable(ModelId(name, 1))
+
+    rng = np.random.default_rng(11)
+    max_new = 32
+    prompts = [
+        rng.integers(0, lm_config["vocab_size"], (1, 24)).astype(np.int32)
+        for _ in range(6)
+    ]
+
+    def timed_tok_s(draft_mid, k) -> float:
+        # reset the acceptance gate per arm: the auto-disable (VERDICT r5
+        # #6) would otherwise silently swap low-acceptance arms to plain
+        # decode mid-measurement and erase the overhead this row prices
+        with runtime._spec_lock:
+            runtime._spec_health.clear()
+        kw = {} if draft_mid is None else {
+            "draft_model_id": draft_mid, "spec_tokens": k,
+        }
+        runtime.generate(target_mid, prompts[0], max_new_tokens=max_new,
+                         **kw)  # compile, untimed
+        t0 = time.perf_counter()
+        for p in prompts[1:]:
+            with runtime._spec_lock:
+                runtime._spec_health.clear()
+            runtime.generate(target_mid, p, max_new_tokens=max_new, **kw)
+        return (len(prompts) - 1) * max_new / (time.perf_counter() - t0)
+
+    out = {"max_new_tokens": max_new, "batch": 1,
+           "plain_tok_s": round(timed_tok_s(None, 0), 1)}
+    for label, dname, d_def, d_params in (
+        ("early_exit", "draft_exit", draft_def, draft_params),
+        ("tiny", "draft_tiny", None, None),
+    ):
+        if d_def is None:
+            d_loaded = runtime._resident.get(ModelId(dname, 1))
+            d_def, d_params = d_loaded.model_def, d_loaded.params
+        for k in (2, 4, 8):
+            out[f"spec_{label}_k{k}_tok_s"] = round(
+                timed_tok_s(ModelId(dname, 1), k), 1
+            )
+        # acceptance health at k=4: emitted tokens per verify round
+        # (spec_tokens+1 = every proposal accepted)
+        _, rounds = speculative_generate(
+            loaded.model_def, loaded.params, d_def, d_params, prompts[1],
+            max_new_tokens=max_new, spec_tokens=4, return_rounds=True,
+        )
+        out[f"spec_{label}_tokens_per_round_k4"] = round(
+            max_new / max(1, int(rounds)), 2
+        )
+    manager.close()
+    return out
+
+
+def bench_prefix_gen(tmp: str, lm_config: dict) -> dict:
+    """Does the prefix KV cache HELP? (VERDICT r5 #4b.) A multi-turn
+    conversation (turn N's prompt = turn N-1's prompt + completion + new
+    user tokens) measured per-turn with the cache on vs the TRUE plain path
+    (cache detached — not a forced miss, which would overpay for cache
+    bookkeeping and flatter the feature) — same runtime, same compile
+    cache, so the delta is exactly the suffix-only-prefill saving."""
+    import numpy as np
+
+    from tfservingcache_tpu.types import ModelId
+
+    manager, runtime = _make_stack("transformer_lm", 1, tmp,
+                                   config=lm_config,
+                                   prefix_cache_bytes=256 << 20)
+    mid = ModelId("tenant0", 1)
+    manager.ensure_servable(mid)
+    pc = runtime._prefix_cache
+    rng = np.random.default_rng(21)
+    turns, max_new = 4, 16
+    vocab = lm_config["vocab_size"]
+
+    def conversation(seed: int, use_cache: bool) -> list[float]:
+        """Per-turn seconds for turns 2..N (turn 1 is a cold miss both ways)."""
+        runtime._prefix_cache = pc if use_cache else None
+        r = np.random.default_rng(seed)
+        prompt = r.integers(0, vocab, 24).astype(np.int32).tolist()
+        lat = []
+        try:
+            for t in range(turns):
+                t0 = time.perf_counter()
+                toks = runtime.generate(
+                    mid, np.asarray([prompt], np.int32),
+                    max_new_tokens=max_new, seed=seed,
+                )
+                dt = time.perf_counter() - t0
+                if t > 0:
+                    lat.append(dt)
+                prompt = prompt + toks[0].tolist() + r.integers(
+                    0, vocab, 4
+                ).astype(np.int32).tolist()
+        finally:
+            runtime._prefix_cache = pc
+        return lat
+
+    conversation(100, False)  # pay every full-prefill compile, untimed
+    conversation(100, True)   # pay every suffix-prefill compile, untimed
+    # counters survive clear(): snapshot after warmup so the reported
+    # hit/miss evidence covers exactly the timed conversations
+    hits0, misses0 = pc.hits, pc.misses
+    on, off = [], []
+    for s in (201, 202, 203):
+        pc.clear()
+        on += conversation(s, True)
+        off += conversation(s, False)
+    on.sort(); off.sort()
+    manager.close()
+    return {
+        "turns": turns, "max_new_tokens": max_new,
+        "conversations": 3,
+        "turn_p50_on_ms": round(on[len(on) // 2] * 1e3, 2),
+        "turn_p50_off_ms": round(off[len(off) // 2] * 1e3, 2),
+        "speedup": round(off[len(off) // 2] / max(1e-9, on[len(on) // 2]), 3),
+        "prefix_hits": pc.hits - hits0, "prefix_misses": pc.misses - misses0,
+    }
+
+
+def watcher_liveness() -> dict:
+    """Probe-history summary from the watcher's state file + log, embedded
+    into EVERY bench artifact — even a CPU-fallback run self-reports whether
+    hardware was ever reachable this round (VERDICT r5 #8: round 4's 'done
+    units: []' was only discoverable by reading watcher.log)."""
+    runs_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_runs")
+    out: dict = {"watcher_running": False}
+    try:
+        r = subprocess.run(
+            ["ps", "-eo", "cmd"], capture_output=True, text=True, timeout=10
+        )
+        out["watcher_running"] = "tpu_bench_watcher" in r.stdout
+    except Exception:  # noqa: BLE001 - liveness summary is best-effort
+        pass
+    state_path = os.path.join(runs_dir, "state.json")
+    try:
+        with open(state_path) as f:
+            state = json.load(f)
+        probe = state.get("_probe", {})
+        units = {
+            u: s for u, s in state.items()
+            if not u.startswith("_") and isinstance(s, dict)
+        }
+        out.update({
+            "units_done": sorted(u for u, s in units.items() if s.get("done")),
+            "units_pending": sorted(
+                u for u, s in units.items() if not s.get("done")
+            ),
+            # a state file with no unit keys predates the seeding watcher:
+            # the burn-down list is unknown, not empty
+            **({} if units else
+               {"units_note": "no unit entries in state (all pending)"}),
+            "probes_total": probe.get("total", 0),
+            "probes_up": probe.get("up", 0),
+            "last_probe_at": probe.get("last_at"),
+            "last_window_at": probe.get("last_up_at"),
+        })
+    except (OSError, ValueError):
+        out["state"] = "no state file (watcher never probed on this host)"
+    log_path = os.path.join(runs_dir, "watcher.log")
+    try:
+        with open(log_path, "rb") as f:
+            f.seek(max(0, os.path.getsize(log_path) - 4096))
+            lines = f.read().decode(errors="replace").splitlines()
+        out["log_tail"] = lines[-3:]
+    except OSError:
+        pass
+    return out
 
 
 def collect_watcher_evidence() -> dict:
@@ -827,8 +1056,9 @@ def collect_watcher_evidence() -> dict:
     if not os.path.isdir(runs_dir):
         return out
     keep_sections = (
-        "mnist_cnn", "transformer_lm", "chip_lm", "flash_kernel",
-        "tenant_soak", "device_kind", "chips", "only",
+        "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
+        "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
+        "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
         if not fn.endswith(".json") or fn.endswith(".partial.json"):
@@ -858,8 +1088,10 @@ def collect_watcher_evidence() -> dict:
 def run(args) -> dict:
     detail = PARTIAL  # sections land here live so the watchdog can salvage
     watcher = collect_watcher_evidence()
-    if watcher:
-        detail["tpu_watcher_evidence"] = watcher
+    # ALWAYS present (empty or not): the artifact must self-report whether
+    # hardware was ever reachable this round (VERDICT r5 #8)
+    detail["tpu_watcher_evidence"] = watcher
+    detail["tpu_watcher_liveness"] = watcher_liveness()
     sel = _parse_only(args.only)
     want = lambda name: sel is None or name in sel
     if sel is not None:
@@ -1060,6 +1292,25 @@ def run(args) -> dict:
         )
     if lm_manager is not None:
         lm_manager.close()
+
+    # round-4 perf features: prove (or refute) them with numbers on every
+    # backend — regressions must surface without the tunnel (VERDICT r5 #4)
+    if want("spec_decode"):
+        try:
+            with _section("spec_decode"):
+                detail["spec_decode"] = bench_spec_decode(
+                    os.path.join(tmp, "spec"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["spec_decode"] = {"error": f"{type(e).__name__}: {e}"}
+    if want("prefix_gen"):
+        try:
+            with _section("prefix_gen"):
+                detail["prefix_gen"] = bench_prefix_gen(
+                    os.path.join(tmp, "prefix"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["prefix_gen"] = {"error": f"{type(e).__name__}: {e}"}
 
     if want("tenant_soak"):
         try:

@@ -228,6 +228,62 @@ def test_spec_tokens_clamped_to_power_of_two_buckets(lm_stack):
                          spec_tokens=0)
 
 
+def test_spec_draft_autodisable_on_low_acceptance(lm_stack, tmp_path, caplog):
+    """An adversarial draft (all-zero params: always proposes token 0) makes
+    every verify round emit ~1 token — strictly more target work per token
+    than plain decode. After SPEC_DISABLE_AFTER such generates the runtime
+    must fall back to plain decode (VERDICT r5 #6), with output exact
+    throughout, and re-audition the pair on the reprobe cadence."""
+    import logging
+
+    from tfservingcache_tpu.models.registry import save_artifact
+    from tfservingcache_tpu.runtime.model_runtime import (
+        SPEC_DISABLE_AFTER,
+        SPEC_REPROBE_EVERY,
+    )
+
+    manager, runtime = lm_stack
+    md = build("transformer_lm", CFG_D)
+    zero_params = jax.tree_util.tree_map(
+        lambda x: np.zeros_like(np.asarray(x)), md.init(jax.random.PRNGKey(9))
+    )
+    save_artifact(str(tmp_path / "store" / "adver" / "1"), md, zero_params)
+    big, adv = ModelId("big", 1), ModelId("adver", 1)
+    manager.ensure_servable(big)
+    manager.ensure_servable(adv)
+
+    rng = np.random.default_rng(5)
+    caplog.set_level(logging.WARNING, logger="tpusc.runtime")
+    for i in range(SPEC_DISABLE_AFTER + 3):
+        ids = rng.integers(1, 128, (1, 8)).astype(np.int32)
+        ref = runtime.generate(big, ids, max_new_tokens=12, temperature=0.0)
+        got = runtime.generate(big, ids, max_new_tokens=12, temperature=0.0,
+                               draft_model_id=adv)
+        # exact before, at, and after the fallback flips
+        np.testing.assert_array_equal(got, ref)
+    st = runtime._spec_health[(big, adv)]
+    assert st["disabled"], st
+    assert any("auto-disabled" in r.message for r in caplog.records)
+    # gated requests skip the draft (plain path) but stay exact
+    before = st["skipped"]
+    ids = rng.integers(1, 128, (1, 8)).astype(np.int32)
+    ref = runtime.generate(big, ids, max_new_tokens=12, temperature=0.0)
+    got = runtime.generate(big, ids, max_new_tokens=12, temperature=0.0,
+                           draft_model_id=adv)
+    np.testing.assert_array_equal(got, ref)
+    assert runtime._spec_health[(big, adv)]["skipped"] == before + 1
+    # reprobe cadence: the SPEC_REPROBE_EVERY-th gated request re-auditions
+    st["skipped"] = SPEC_REPROBE_EVERY - 1
+    assert runtime._spec_admit(big, adv) is True
+    # a healthy audition re-enables the pair
+    runtime._spec_observe(big, adv, emitted=16, rounds=4)
+    assert not runtime._spec_health[(big, adv)]["disabled"]
+    assert runtime._spec_admit(big, adv) is True
+    # eviction clears the pair's history
+    runtime.unload(adv)
+    assert (big, adv) not in runtime._spec_health
+
+
 async def test_rest_draft_bad_version_is_400(tmp_path):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager

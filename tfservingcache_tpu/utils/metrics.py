@@ -120,6 +120,17 @@ class Metrics:
             "Device bytes held by cached prompt-prefix KV entries",
             registry=r,
         )
+        self.spec_draft_autodisabled = Counter(
+            "tpusc_spec_draft_autodisabled_total",
+            "Draft models auto-disabled after sustained low acceptance",
+            registry=r,
+        )
+        self.spec_tokens_per_round = Gauge(
+            "tpusc_spec_tokens_per_round",
+            "Most recent speculative acceptance (emitted tokens per verify "
+            "round; spec_tokens+1 = every proposal accepted)",
+            registry=r,
+        )
 
     def model_label(self, name: str, version: int | str) -> str:
         if self.model_labels:

@@ -44,7 +44,8 @@ UNITS: list[tuple[str, list[str], float]] = [
      1500.0),
     ("batcher_qps", ["bench.py", "--only", "mnist_qps,lm_qps,lm_throughput"],
      1800.0),
-    ("routed_soak", ["bench.py", "--only", "routed,tenant_soak"], 1200.0),
+    ("gen_features", ["bench.py", "--only", "spec_decode,prefix_gen"], 1200.0),
+    ("routed_soak", ["bench.py", "--only", "routed,tenant_soak"], 1500.0),
     ("full", ["bench.py"], 2100.0),
 ]
 
@@ -120,7 +121,7 @@ def commit_dirty_artifacts() -> None:
     try:
         r = subprocess.run(
             ["git", "status", "--porcelain", "--", "tpu_runs",
-             "KERNEL_CHECK_r04.txt"],
+             "KERNEL_CHECK_r05.txt"],
             cwd=REPO, timeout=60, capture_output=True, text=True,
         )
         dirty = [
@@ -160,6 +161,10 @@ def unit_ok(name: str, payload: dict) -> bool:
             ("mnist_cnn", "warm_grpc_qps_batch"),
             ("transformer_lm", "warm_rest_qps"),
             ("transformer_lm", "warm_rest_qps_batch"),
+        ],
+        "gen_features": [
+            ("spec_decode", "plain_tok_s"),
+            ("prefix_gen", "turn_p50_on_ms"),
         ],
         "routed_soak": [
             ("mnist_cnn", "routed_rest_qps"),
@@ -222,7 +227,7 @@ def run_unit(name: str, argv: list[str], budget_s: float) -> bool:
             f.write(stdout)
         ok = r.returncode == 0 and "[kernel]" in stdout
         if ok:
-            kc = os.path.join(REPO, "KERNEL_CHECK_r04.txt")
+            kc = os.path.join(REPO, "KERNEL_CHECK_r05.txt")
             with open(kc, "w") as f:
                 f.write(stdout)
             commit([out_path, kc], "TPU watcher: kernel check with magnitudes")
@@ -261,12 +266,20 @@ def run_unit(name: str, argv: list[str], budget_s: float) -> bool:
 
 def main() -> int:
     state = load_state()
+    # seed every known unit so readers of state.json (bench.py
+    # watcher_liveness) see the full pending list even before the first
+    # window — not just the units that happened to be attempted
+    for u, _argv, _b in UNITS:
+        state.setdefault(u, {"done": False})
+    save_state(state)
     # seed from persisted state: a restarted watcher must keep preferring
     # never-attempted units over known-failing ones
     fails: dict[str, int] = {
-        u: s.get("fails", 0) for u, s in state.items() if s.get("fails")
+        u: s.get("fails", 0) for u, s in state.items()
+        if not u.startswith("_") and isinstance(s, dict) and s.get("fails")
     }
-    log(f"starting; done units: {[u for u, s in state.items() if s.get('done')]}")
+    log("starting; done units: "
+        f"{[u for u, s in state.items() if not u.startswith('_') and isinstance(s, dict) and s.get('done')]}")
     while True:
         commit_dirty_artifacts()
         pending = [u for u in UNITS if not state.get(u[0], {}).get("done")]
@@ -274,7 +287,16 @@ def main() -> int:
             log("all units measured on TPU; idling (re-run to re-measure)")
             time.sleep(3600)
             continue
-        if not probe():
+        up = probe()
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        pr = state.setdefault("_probe", {})
+        pr["total"] = pr.get("total", 0) + 1
+        pr["last_at"] = now
+        if up:
+            pr["up"] = pr.get("up", 0) + 1
+            pr["last_up_at"] = now
+        save_state(state)
+        if not up:
             log(f"tunnel down; {len(pending)} units pending; "
                 f"sleeping {SLEEP_DOWN_S:.0f}s")
             time.sleep(SLEEP_DOWN_S)
