@@ -37,6 +37,9 @@ async def main() -> None:
     cfg.cache_node.rest_port = 0
     cfg.cache_node.grpc_port = 0
     cfg.serving.load_timeout_s = 240.0
+    # cross-host prefix KV cache (VERDICT r5 #7): leader decides, envelope
+    # ships the decision, every process reuses its own K/V shards
+    cfg.serving.prefix_cache_bytes = 64 << 20
     cfg.mesh.chips_per_group = 4 * len(worker_ports)
     cfg.mesh.coordinator = f"127.0.0.1:{coord}"
     cfg.mesh.num_processes = len(worker_ports)
@@ -87,6 +90,28 @@ async def main() -> None:
         ) as resp:
             assert resp.status == 200, await resp.text()
             toks = np.asarray((await resp.json())["tokens"], np.int32)
+        # 2-turn conversation: turn 2 must HIT the cross-host prefix cache
+        # (leader decides, followers obey the envelope's prefix_rows) and
+        # still answer 200 with B=1-shaped output
+        conv1 = list(range(2, 26))  # 24 tokens -> 32 valid rows -> 16 stored
+        async with s.post(
+            f"{base}:generate",
+            json={"input_ids": [conv1], "max_new_tokens": 8, "seed": 7},
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            c1 = (await resp.json())["tokens"][0]
+        conv2 = conv1 + c1 + [9, 10]
+        async with s.post(
+            f"{base}:generate",
+            json={"input_ids": [conv2], "max_new_tokens": 8, "seed": 7},
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            c2 = (await resp.json())["tokens"][0]
+        lead_pc = node.groups[0].manager.runtime._prefix_cache
+        assert lead_pc is not None and lead_pc.hits >= 1, (
+            lead_pc and (lead_pc.hits, lead_pc.misses)
+        )
+        print(f"PREFIX GROUP HIT OK hits={lead_pc.hits}", flush=True)
 
     # parity vs an unsharded runtime on this process's local chips
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
@@ -96,7 +121,9 @@ async def main() -> None:
     from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
     from tfservingcache_tpu.types import ModelId
 
-    rt1 = TPUModelRuntime(ServingConfig())
+    # reference gets the prefix cache too: the conversation parity below
+    # must compare suffix-prefill against suffix-prefill (same shapes)
+    rt1 = TPUModelRuntime(ServingConfig(prefix_cache_bytes=64 << 20))
     mgr1 = CacheManager(
         DiskModelProvider(store),
         ModelDiskCache(os.path.join(run_dir, "cache_ref"), capacity_bytes=1 << 30),
@@ -116,6 +143,15 @@ async def main() -> None:
         mid, np.asarray(ids, np.int32), max_new_tokens=4, seed=3
     )
     np.testing.assert_array_equal(toks, want_toks)  # greedy = exact
+    # conversation parity: the group's prefix-hit turn must emit exactly
+    # what the unsharded prefix-hit path emits
+    w1 = rt1.generate(mid, np.asarray([conv1], np.int32), max_new_tokens=8,
+                      seed=7)
+    np.testing.assert_array_equal(np.asarray([c1], np.int32), w1)
+    w2 = rt1.generate(mid, np.asarray([conv2], np.int32), max_new_tokens=8,
+                      seed=7)
+    np.testing.assert_array_equal(np.asarray([c2], np.int32), w2)
+    assert rt1._prefix_cache.hits >= 1
     mgr1.close()
     await node.close()
     print("MULTIHOST PARITY OK", flush=True)

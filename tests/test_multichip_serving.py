@@ -6,6 +6,8 @@ whose ring assigns tenants to groups (VERDICT.md round-1 item #2; SURVEY.md
 
 import asyncio
 
+import pytest
+
 import aiohttp
 import jax
 import numpy as np
@@ -95,6 +97,68 @@ async def test_sharded_predict_through_backend_matches_unsharded(tmp_path):
         backend.close()
         mgr.close()
         mgr_1.close()
+
+
+def test_prefix_cache_on_mesh_runtime_parity(tmp_path):
+    """VERDICT r5 #7: the prefix KV cache now works for group-served models.
+    On the 8-device TP mesh a 2-turn conversation must register a hit
+    (sharded K/V reused across turns) and emit exactly what the same mesh
+    runtime's plain path emits; the forced-decision plumbing (prefix_rows,
+    the group envelope's field) must agree with local decisions."""
+    store = tmp_path / "store"
+    cfg = dict(SMALL, max_seq=128, dtype="float32")
+    export_artifact("transformer_lm", str(store), name="lm", version=1,
+                    config=cfg)
+    mesh = make_mesh({"model": 8})
+    rt = TPUModelRuntime(ServingConfig(prefix_cache_bytes=64 << 20), mesh=mesh)
+    mgr = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache_pfx"), capacity_bytes=1 << 30),
+        rt,
+    )
+    rt_plain = TPUModelRuntime(ServingConfig(), mesh=make_mesh({"model": 8}))
+    mgr_plain = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache_pl"), capacity_bytes=1 << 30),
+        rt_plain,
+    )
+    try:
+        mid = ModelId("lm", 1)
+        mgr.ensure_servable(mid)
+        mgr_plain.ensure_servable(mid)
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, 128, 24).astype(np.int32).tolist()
+        pc = rt._prefix_cache
+        assert pc is not None  # mesh runtimes get the cache now
+        t1 = rt.generate(mid, np.asarray([prompt], np.int32),
+                         max_new_tokens=8, seed=5)
+        w1 = rt_plain.generate(mid, np.asarray([prompt], np.int32),
+                               max_new_tokens=8, seed=5)
+        np.testing.assert_array_equal(t1, w1)
+        turn2 = prompt + t1[0].tolist() + rng.integers(0, 128, 4).astype(np.int32).tolist()
+        # the envelope decision a leader would ship: peek agrees with state
+        rows = pc.peek(mid, np.asarray(turn2, np.int32))
+        assert rows >= 16, rows
+        # forced decision (the follower path) == local decision
+        t2 = rt.generate(mid, np.asarray([turn2], np.int32),
+                         max_new_tokens=8, seed=5, prefix_rows=rows)
+        w2 = rt_plain.generate(mid, np.asarray([turn2], np.int32),
+                               max_new_tokens=8, seed=5)
+        assert pc.hits >= 1, (pc.hits, pc.misses)
+        np.testing.assert_array_equal(t2, w2)
+        # cached K/V really is sharded across the mesh
+        ent = next(iter(rt._prefix_cache._by_model[mid].values()))
+        assert len(ent.k.sharding.device_set) == 8
+        # a forced hit this cache cannot honor fails loudly BEFORE any
+        # device op (group-divergence containment), not with wrong output
+        from tfservingcache_tpu.runtime.base import RuntimeError_
+
+        with pytest.raises(RuntimeError_, match="divergence"):
+            rt.generate(mid, np.asarray([turn2], np.int32),
+                        max_new_tokens=8, seed=5, prefix_rows=4096)
+    finally:
+        mgr.close()
+        mgr_plain.close()
 
 
 async def test_two_group_cache_node_rings_models_to_groups(tmp_path):

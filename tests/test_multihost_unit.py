@@ -182,6 +182,141 @@ async def test_follower_timeout_tied_to_request_deadline():
         await srv.close()
 
 
+async def test_group_failure_containment_and_reformation(tmp_path, monkeypatch):
+    """VERDICT r5 #5: kill a follower mid-stream -> the leader marks the
+    group unhealthy (pending + new requests fail fast with
+    GroupUnhealthyError/503, not queue into the wedge), its ring heartbeat
+    fails (manager.is_healthy False -> discovery drops the group), and when
+    the follower comes back the reform loop resets every process's group
+    state and re-serves."""
+    import time as _time
+
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.parallel import multihost as mh
+    from tfservingcache_tpu.runtime.base import GroupUnhealthyError
+
+    monkeypatch.setattr(mh, "REFORM_PROBE_PERIOD_S", 0.2)
+
+    class _ResettableRuntime(_RecordingRuntime):
+        def reset_group_state(self):
+            self.calls.append(("reset",))
+
+    handler = GroupWorkHandler()
+    mgr, rt = _RecordingManager(), _ResettableRuntime()
+    handler.register(0, mgr, rt)
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+
+    leader = MultiHostGroupRuntime(
+        ServingConfig(platform="cpu", load_timeout_s=2.0),
+        followers=[f"127.0.0.1:{port}"],
+        group_index=0,
+    )
+    # the ring-health wiring: router pairs this manager's is_healthy with
+    # the group's membership entry
+    (tmp_path / "store").mkdir()
+    manager = CacheManager(
+        DiskModelProvider(str(tmp_path / "store")),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 20),
+        leader,
+    )
+    loop = asyncio.get_running_loop()
+    try:
+        # healthy: a collective round-trips and the heartbeat passes
+        await loop.run_in_executor(None, lambda: leader._run_collective(
+            {"op": "ensure", "model": "m", "version": 1}, None, lambda: None
+        ))
+        assert await loop.run_in_executor(None, manager.is_healthy)
+
+        # kill the follower mid-stream
+        await srv.close()
+        with pytest.raises(RuntimeError, match="followers failed"):
+            await loop.run_in_executor(None, lambda: leader._run_collective(
+                {"op": "ensure", "model": "m", "version": 1}, None,
+                lambda: None,
+            ))
+        assert leader._unhealthy_reason is not None
+
+        # new requests fail FAST (no queueing into the dead group) ...
+        t0 = _time.monotonic()
+        with pytest.raises(GroupUnhealthyError, match="re-forming"):
+            leader._run_collective(
+                {"op": "ensure", "model": "m", "version": 1}, None,
+                lambda: None,
+            )
+        assert _time.monotonic() - t0 < 0.5
+        # ... and the group's ring heartbeat fails -> discovery drops it
+        assert not await loop.run_in_executor(None, manager.is_healthy)
+
+        # follower returns on the same address: the reform loop must ping
+        # it, broadcast a reset, reset the leader, and rejoin
+        handler2 = GroupWorkHandler()
+        rt2 = _ResettableRuntime()
+        handler2.register(0, _RecordingManager(), rt2)
+        srv = GroupWorkServer(handler2)
+        await srv.start(port, host="127.0.0.1")
+        deadline = _time.monotonic() + 10.0
+        while leader._unhealthy_reason is not None:
+            assert _time.monotonic() < deadline, "group never re-formed"
+            await asyncio.sleep(0.1)
+        assert ("reset",) in rt2.calls  # the restarted follower was reset
+        # re-serves: collectives and the heartbeat work again
+        await loop.run_in_executor(None, lambda: leader._run_collective(
+            {"op": "ensure", "model": "m", "version": 1}, None, lambda: None
+        ))
+        assert await loop.run_in_executor(None, manager.is_healthy)
+        # a STALE failure signal from before the re-formation (an in-flight
+        # timeout resolving late) must not re-tear-down the healthy group
+        assert leader._epoch == 1
+        leader._mark_unhealthy("late pre-teardown timeout", epoch=0)
+        assert leader._unhealthy_reason is None
+    finally:
+        leader.close()
+        await srv.close()
+
+
+async def test_wedged_follower_timeout_contains_group(monkeypatch):
+    """A follower that is alive but WEDGED (work call exceeds the op
+    deadline) must also tear the group down — and while it stays wedged
+    (ping finds the lock busy), re-formation must NOT proceed."""
+    import time as _time
+
+    from tfservingcache_tpu.parallel import multihost as mh
+
+    monkeypatch.setattr(mh, "REFORM_PROBE_PERIOD_S", 0.2)
+
+    class _WedgedManager(_RecordingManager):
+        def ensure_servable(self, mid):
+            _time.sleep(8.0)  # stuck mid-collective (short enough to unwind at exit)
+
+    handler = GroupWorkHandler()
+    handler.register(0, _WedgedManager(), _RecordingRuntime())
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    leader = MultiHostGroupRuntime(
+        ServingConfig(platform="cpu", load_timeout_s=0.5),
+        followers=[f"127.0.0.1:{port}"],
+        group_index=0,
+    )
+    loop = asyncio.get_running_loop()
+    try:
+        with pytest.raises(RuntimeError, match="followers failed"):
+            await loop.run_in_executor(None, lambda: leader._run_collective(
+                {"op": "ensure", "model": "m", "version": 1}, None,
+                lambda: None,
+            ))
+        assert leader._unhealthy_reason is not None
+        # the wedged follower answers pings with "lock busy", so the group
+        # must still be down after several probe periods
+        await asyncio.sleep(1.0)
+        assert leader._unhealthy_reason is not None
+    finally:
+        leader.close()
+        await srv.close()
+
+
 async def test_follower_drops_expired_queued_prefetch_only():
     """A PREFETCH whose budget elapsed while queued fails fast (the leader
     abandoned it), but collective ops must run however late — the leader has

@@ -38,6 +38,7 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from tfservingcache_tpu.runtime.base import GroupUnhealthyError
 from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
 from tfservingcache_tpu.types import ModelId
 from tfservingcache_tpu.utils.logging import get_logger
@@ -45,6 +46,16 @@ from tfservingcache_tpu.utils.logging import get_logger
 log = get_logger("multihost")
 
 WORK_PATH = "/tpusc/groupwork"
+# unhealthy-group re-formation probe cadence (leader pings followers, then
+# resets the whole group to an empty lockstep state)
+REFORM_PROBE_PERIOD_S = 5.0
+PING_TIMEOUT_S = 2.0
+
+
+class FollowerUnreachable(RuntimeError):
+    """Transport-level follower failure: connection refused/reset or work
+    timeout — the process is dead or wedged, as opposed to a live follower
+    answering 500 (an application error scoped to one request)."""
 
 
 def encode_work(meta: dict, arrays: Mapping[str, np.ndarray] | None = None) -> bytes:
@@ -86,8 +97,22 @@ class GroupWorkHandler:
                  t_arrival: float | None = None) -> None:
         gi = int(meta["group"])
         manager, runtime = self._groups[gi]
-        mid = ModelId(meta["model"], int(meta["version"]))
         op = meta["op"]
+        if op == "ping":
+            # reform probe: alive AND able to take the group lock soon — a
+            # follower wedged mid-op answers "busy", so the leader keeps the
+            # group down instead of re-forming against a stuck process
+            lock = self._locks[gi]
+            if not lock.acquire(timeout=float(meta.get("lock_timeout_s", 0.5))):
+                raise TimeoutError("group lock busy (possibly wedged mid-op)")
+            lock.release()
+            return
+        if op == "reset":
+            with self._locks[gi]:
+                runtime.reset_group_state()
+            log.info("group %d state reset for re-formation", gi)
+            return
+        mid = ModelId(meta["model"], int(meta["version"]))
         with self._locks[gi]:  # same-order guarantee as the leader's lock
             # the leader ships its remaining request budget; a PREFETCH that
             # already spent it queued behind the group lock is one the leader
@@ -126,6 +151,7 @@ class GroupWorkHandler:
                 )
                 if draft_mid is not None:
                     manager.ensure_servable(draft_mid)
+                pr = meta.get("prefix_rows")
                 runtime.generate(
                     mid,
                     arrays["input_ids"],
@@ -136,6 +162,10 @@ class GroupWorkHandler:
                     seed=int(meta["seed"]),  # MUST match the leader's draw
                     draft_model_id=draft_mid,
                     spec_tokens=int(meta.get("spec_tokens", 4)),
+                    # the leader's prefix-cache decision: this process must
+                    # run the same program (None = decide locally, pre-r5
+                    # leaders)
+                    prefix_rows=None if pr is None else int(pr),
                 )
             elif op == "unload":
                 runtime.unload(mid)
@@ -224,39 +254,86 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             max_workers=max(4, 2 * len(self._followers)),
             thread_name_prefix="tpusc-bcast",
         )
+        # failure containment (VERDICT r5 #5): a transport-dead follower
+        # flips the group unhealthy — requests fail fast (503), the ring
+        # heartbeat drops this group (check() below), and the reform thread
+        # probes until every follower answers, then resets the whole group
+        # to an empty lockstep state and rejoins
+        self._unhealthy_reason: str | None = None
+        self._health_lock = threading.Lock()
+        self._reform_thread: threading.Thread | None = None
+        self._closing = threading.Event()
+        # bumped on every successful re-formation: stale failure signals
+        # from the pre-teardown era (a slow timeout resolving after the
+        # group already re-formed) must not re-tear-down the new group
+        self._epoch = 0
 
     # -- broadcast plumbing -------------------------------------------------
-    def _post(self, addr: str, body: bytes) -> None:
+    def _post(self, addr: str, body: bytes,
+              timeout_s: float | None = None) -> None:
         req = urllib.request.Request(
             f"http://{addr}{WORK_PATH}", data=body,
             headers={"Content-Type": "application/octet-stream"},
         )
         try:
-            with urllib.request.urlopen(req, timeout=self._op_timeout_s) as resp:
+            with urllib.request.urlopen(
+                req, timeout=timeout_s or self._op_timeout_s
+            ) as resp:
                 out = json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             # the follower's 500 carries the actual cause in its JSON body —
-            # surface it, not just "HTTP Error 500"
+            # surface it, not just "HTTP Error 500". An HTTP status means the
+            # process is ALIVE: this is an application error, not group death.
             try:
                 detail = json.loads(e.read().decode()).get("error", str(e))
             except Exception:  # noqa: BLE001
                 detail = str(e)
             raise RuntimeError(f"follower {addr}: {detail}") from None
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            # connection refused/reset or work timeout: dead or wedged
+            raise FollowerUnreachable(f"follower {addr}: {e}") from None
         if not out.get("ok"):
             raise RuntimeError(f"follower {addr}: {out.get('error')}")
 
-    def _broadcast(self, meta: dict, arrays: Mapping[str, np.ndarray] | None = None):
+    def _broadcast(self, meta: dict, arrays: Mapping[str, np.ndarray] | None = None,
+                   collective: bool = False):
         # budget_s lets the follower drop items that expire while queued
         # behind its group lock (the leader has long since 504'd them)
         meta = dict(meta, group=self._group_index, budget_s=self._op_timeout_s)
         body = encode_work(meta, arrays)
-        return [
+        futures = [
             self._bcast_pool.submit(self._post, addr, body)
             for addr in self._followers
         ]
+        if collective:
+            # transport death during a collective phase: mark the group down
+            # the moment the future resolves — the leader's local half may be
+            # wedged inside the collective and never reach _join. ONLY
+            # transport errors here: an application-level 500 from a LIVE
+            # follower is classified at _run_collective's join (symmetric
+            # validation failures must not let one malformed request tear
+            # the group down). The epoch tag stops a slow pre-teardown
+            # failure from re-tearing-down an already re-formed group.
+            epoch = self._epoch
 
-    @staticmethod
-    def _join(futures) -> None:
+            def _watch(f):
+                if isinstance(f.exception(), FollowerUnreachable):
+                    self._mark_unhealthy(
+                        f"follower died during a collective: {f.exception()}",
+                        epoch=epoch,
+                    )
+
+            for f in futures:
+                f.add_done_callback(_watch)
+        return futures
+
+    def _acquire_group_lock(self) -> None:
+        """Bounded acquire: a request queued behind a wedged op must notice
+        the group went unhealthy and 503 out instead of waiting forever."""
+        while not self._group_lock.acquire(timeout=0.5):
+            self._require_healthy()
+
+    def _join(self, futures) -> None:
         errs = []
         for f in futures:
             try:
@@ -264,9 +341,103 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             except Exception as e:  # noqa: BLE001
                 errs.append(e)
         if errs:
-            raise RuntimeError(
-                f"group followers failed: {'; '.join(str(e) for e in errs)}"
+            msg = f"group followers failed: {'; '.join(str(e) for e in errs)}"
+            if any(isinstance(e, FollowerUnreachable) for e in errs):
+                # a dead/wedged follower poisons the whole group's lockstep
+                # guarantee — contain it (fail fast + leave the ring) rather
+                # than let every request queue into the wedge
+                self._mark_unhealthy(msg)
+            raise RuntimeError(msg)
+
+    # -- failure containment / re-formation ---------------------------------
+    def _mark_unhealthy(self, reason: str, epoch: int | None = None) -> None:
+        with self._health_lock:
+            if epoch is not None and epoch != self._epoch:
+                return  # signal from before a completed re-formation: stale
+            if self._unhealthy_reason is not None or self._closing.is_set():
+                return
+            self._unhealthy_reason = reason
+            log.error(
+                "cross-host group %d torn down: %s — failing requests fast, "
+                "leaving the ring, probing for re-formation every %.0fs",
+                self._group_index, reason, REFORM_PROBE_PERIOD_S,
             )
+            if self.metrics is not None:
+                self.metrics.group_reforms.labels(
+                    str(self._group_index), "torn_down"
+                ).inc()
+            self._reform_thread = threading.Thread(
+                target=self._reform_loop, name="tpusc-reform", daemon=True
+            )
+            self._reform_thread.start()
+
+    def _require_healthy(self) -> None:
+        reason = self._unhealthy_reason
+        if reason is not None:
+            raise GroupUnhealthyError(
+                f"cross-host group {self._group_index} is re-forming after a "
+                f"follower failure ({reason}); retry against a replica"
+            )
+
+    def _reform_loop(self) -> None:
+        """Probe followers until all answer, then reset every process's group
+        state (empty resident set — parity is re-derived by cold loads, the
+        reference's remap semantics, SURVEY §3.4) and rejoin the ring."""
+        while not self._closing.wait(REFORM_PROBE_PERIOD_S):
+            try:
+                ping = encode_work({
+                    "op": "ping", "group": self._group_index,
+                    "lock_timeout_s": 0.5,
+                })
+                for addr in self._followers:
+                    self._post(addr, ping, timeout_s=PING_TIMEOUT_S)
+            except Exception as e:  # noqa: BLE001 - keep probing
+                log.info(
+                    "group %d still down (%s)", self._group_index, e
+                )
+                continue
+            # every follower is alive with a free group lock: re-form. The
+            # local half may still hold the group lock if it is wedged inside
+            # a collective — bound the acquire and keep the group down rather
+            # than reset state under a live op (only a process restart clears
+            # a truly wedged XLA collective; same recovery story as the
+            # reference's dead node, supervisor-owned).
+            if not self._group_lock.acquire(timeout=PING_TIMEOUT_S):
+                log.warning(
+                    "group %d followers recovered but the leader half is "
+                    "still wedged; restart required", self._group_index,
+                )
+                continue
+            try:
+                self._join(self._broadcast({"op": "reset"}))
+                self.reset_group_state()
+            except Exception as e:  # noqa: BLE001 - a failed reset retries
+                log.warning("group %d re-formation failed: %s",
+                            self._group_index, e)
+                continue
+            finally:
+                self._group_lock.release()
+            with self._health_lock:
+                self._unhealthy_reason = None
+                self._epoch += 1  # invalidate stale pre-teardown signals
+            log.info(
+                "cross-host group %d re-formed (empty state) and rejoined "
+                "the ring", self._group_index,
+            )
+            if self.metrics is not None:
+                self.metrics.group_reforms.labels(
+                    str(self._group_index), "reformed"
+                ).inc()
+            return
+
+    def check(self) -> None:
+        """Ring/health probe: an unhealthy group FAILS its heartbeat so
+        discovery drops exactly this group's membership and replicas absorb
+        its keys (router.py pairs each group ident with its manager's
+        is_healthy — the group-level analogue of reference cluster.go
+        dead-node remap)."""
+        self._require_healthy()
+        super().check()
 
     def _run_collective(self, meta, arrays, fn):
         """Fire the broadcast, run the local half of the collective, then
@@ -280,22 +451,48 @@ class MultiHostGroupRuntime(TPUModelRuntime):
         jax.distributed coordination service then detects the dead/failed
         task and fails the whole group's processes for a supervisor restart
         — there is no in-band recovery from a half-entered collective."""
-        with self._group_lock:
-            futures = self._broadcast(meta, arrays)
+        self._require_healthy()  # fail fast, never queue into a dead group
+        self._acquire_group_lock()
+        try:
+            self._require_healthy()  # the group may have died while queued
+            # meta may be a callable: decisions that must be made atomically
+            # with the op stream (e.g. the prefix-cache hit decision) are
+            # computed here, under the group lock, just before the broadcast
+            futures = self._broadcast(
+                meta() if callable(meta) else meta, arrays, collective=True
+            )
             try:
                 result = fn()
             except BaseException:
+                # the leader's half ALSO failed: a symmetric failure (every
+                # process rejected the same bad request before device work)
+                # is an ordinary request error, not group death — transport
+                # deaths still mark via _join/_watch
                 self._join(futures)  # follower errors usually explain ours
                 raise
-            self._join(futures)
+            try:
+                self._join(futures)
+            except RuntimeError:
+                # the leader completed the op but a LIVE follower failed it:
+                # the processes' states have diverged (one ran the op, one
+                # didn't) — the lockstep guarantee is gone, re-form
+                self._mark_unhealthy(
+                    "follower failed a collective op the leader completed "
+                    "(states diverged)"
+                )
+                raise
             return result
+        finally:
+            self._group_lock.release()
 
     # -- collective ops -----------------------------------------------------
     def ensure_loaded(self, model) -> None:
         if self.is_loaded(model.identifier):
             return
         mid = model.identifier
-        with self._group_lock:
+        self._require_healthy()
+        self._acquire_group_lock()
+        try:
             # phase 1 (joinable, host-side only): every process fetches the
             # artifact to its local disk; any provider/IO failure surfaces
             # HERE, before a single process enters the warmup collective
@@ -308,6 +505,8 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 None,
                 lambda: super(MultiHostGroupRuntime, self).ensure_loaded(model),
             )
+        finally:
+            self._group_lock.release()
 
     def predict(self, model_id, inputs, output_filter=None):
         return self._run_collective(
@@ -324,15 +523,36 @@ class MultiHostGroupRuntime(TPUModelRuntime):
     def generate(self, model_id, input_ids, prompt_lengths=None,
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  top_k: int = 0, seed: int = 0, draft_model_id=None,
-                 spec_tokens: int = 4):
+                 spec_tokens: int = 4, prefix_rows=None):
         ids = np.asarray(input_ids, np.int32)
         lengths = (
             np.full((ids.shape[0],), ids.shape[1], np.int32)
             if ids.ndim == 2 and prompt_lengths is None
             else np.asarray(prompt_lengths if prompt_lengths is not None else [], np.int32)
         )
-        return self._run_collective(
-            {
+        # leader-decides prefix caching (VERDICT r5 #7): the hit decision is
+        # made HERE, under the group lock (meta is a callable — see
+        # _run_collective), and shipped in the envelope so every process
+        # provably runs the same program. A follower whose cache cannot
+        # honor it raises before any device op (lockstep divergence -> the
+        # containment path tears the group down for a reset).
+        decision = {"rows": None}
+
+        def meta() -> dict:
+            if (
+                self._prefix_cache is not None
+                and ids.ndim == 2
+                and ids.shape[0] == 1
+                and draft_model_id is None
+                # malformed prompt_lengths must reach generate's own
+                # validation (clean 400), not crash the peek with IndexError
+                and lengths.shape == (1,)
+                and 1 <= int(lengths[0]) <= ids.shape[1]
+            ):
+                decision["rows"] = self._prefix_cache.peek(
+                    model_id, ids[0, : int(lengths[0])]
+                )
+            return {
                 "op": "generate", "model": model_id.name,
                 "version": model_id.version, "max_new_tokens": max_new_tokens,
                 "temperature": temperature, "top_k": top_k, "seed": seed,
@@ -341,13 +561,17 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 "draft_model": draft_model_id.name if draft_model_id else "",
                 "draft_version": draft_model_id.version if draft_model_id else 0,
                 "spec_tokens": spec_tokens,
-            },
+                "prefix_rows": decision["rows"],
+            }
+
+        return self._run_collective(
+            meta,
             {"input_ids": ids, "prompt_lengths": lengths},
             lambda: super(MultiHostGroupRuntime, self).generate(
                 model_id, ids, prompt_lengths=list(lengths),
                 max_new_tokens=max_new_tokens, temperature=temperature,
                 top_k=top_k, seed=seed, draft_model_id=draft_model_id,
-                spec_tokens=spec_tokens,
+                spec_tokens=spec_tokens, prefix_rows=decision["rows"],
             ),
         )
 
@@ -355,13 +579,31 @@ class MultiHostGroupRuntime(TPUModelRuntime):
         # unload holds no collectives, but followers must mirror it so the
         # group's LRU states stay in lockstep (divergent eviction would make
         # a later follower re-load run its warmup collective solo)
-        with self._group_lock:
+        self._require_healthy()
+        self._acquire_group_lock()
+        try:
+            # collective=True: a failed follower unload diverges the group's
+            # LRU lockstep (a later re-load would run its warmup solo)
             futures = self._broadcast(
-                {"op": "unload", "model": model_id.name, "version": model_id.version}
+                {"op": "unload", "model": model_id.name,
+                 "version": model_id.version},
+                collective=True,
             )
             super().unload(model_id)
-            self._join(futures)
+            try:
+                self._join(futures)
+            except RuntimeError:
+                # leader unloaded, a live follower didn't: divergent LRU
+                # states would run a later warmup collective solo
+                self._mark_unhealthy(
+                    "follower failed an unload the leader completed "
+                    "(LRU states diverged)"
+                )
+                raise
+        finally:
+            self._group_lock.release()
 
     def close(self) -> None:
+        self._closing.set()
         self._bcast_pool.shutdown(wait=False, cancel_futures=True)
         super().close()

@@ -30,6 +30,7 @@ from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, Serv
 from tfservingcache_tpu.protocol.protos import tf_core_pb2 as core
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
 from tfservingcache_tpu.runtime.base import (
+    GroupUnhealthyError,
     LoadTimeoutError,
     ModelNotLoadedError,
     RuntimeError_,
@@ -173,6 +174,9 @@ class LocalServingBackend(ServingBackend):
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
         except LoadTimeoutError as e:
             raise BackendError(str(e), grpc.StatusCode.DEADLINE_EXCEEDED, 504) from e
+        except GroupUnhealthyError as e:
+            # fail fast + retriable elsewhere: replicas/other groups absorb
+            raise BackendError(str(e), grpc.StatusCode.UNAVAILABLE, 503) from e
         except RuntimeError_ as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
 
@@ -183,6 +187,8 @@ class LocalServingBackend(ServingBackend):
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
         except LoadTimeoutError as e:
             raise BackendError(str(e), grpc.StatusCode.DEADLINE_EXCEEDED, 504) from e
+        except GroupUnhealthyError as e:
+            raise BackendError(str(e), grpc.StatusCode.UNAVAILABLE, 503) from e
         except RuntimeError_ as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 500) from e
 
@@ -659,6 +665,8 @@ class LocalServingBackend(ServingBackend):
 
         try:
             tokens = await self._run_bounded("generate", model_id, run)
+        except GroupUnhealthyError as e:
+            raise BackendError(str(e), grpc.StatusCode.UNAVAILABLE, 503) from e
         except RuntimeError_ as e:
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
         return RestResponse(status=200, body=json.dumps({"tokens": tokens.tolist()}).encode())

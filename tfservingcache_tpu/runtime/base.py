@@ -39,6 +39,16 @@ class LoadTimeoutError(RuntimeError_):
     Maps to HTTP 504 / gRPC DEADLINE_EXCEEDED at the protocol layer."""
 
 
+class GroupUnhealthyError(RuntimeError_):
+    """A cross-host group lost a follower (socket death / work timeout
+    during a collective) and is torn down pending re-formation
+    (parallel/multihost.py). Requests fail fast with this — they must not
+    queue behind the wedged op — and the group's ring heartbeat fails so
+    replicas and other groups absorb its traffic (the group-level analogue
+    of the reference's dead-node ring remap, cluster.go:104-113). Maps to
+    HTTP 503 / gRPC UNAVAILABLE."""
+
+
 class BaseRuntime(abc.ABC):
     def __init__(self) -> None:
         self._states: dict[ModelId, ModelState] = {}

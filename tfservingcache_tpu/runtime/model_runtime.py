@@ -235,11 +235,14 @@ class TPUModelRuntime(BaseRuntime):
         )
         self._load_locks: dict[ModelId, threading.Lock] = {}
         self._load_locks_guard = threading.Lock()
-        # prefix KV cache (OFF unless budgeted): single-group runtimes only —
-        # on a cross-host group the leader's hit and a follower's miss would
-        # run DIFFERENT programs and wedge the collective
+        # prefix KV cache (OFF unless budgeted). Mesh/group runtimes get it
+        # too (VERDICT r5 #7): on a cross-host group every process's cache
+        # evolves identically under the lockstep op stream, the LEADER's hit
+        # decision rides the work envelope (prefix_rows below) so followers
+        # provably run the same program, and a reform (multihost.py) resets
+        # every cache to empty together
         self._prefix_cache = None
-        if self.cfg.prefix_cache_bytes > 0 and mesh is None:
+        if self.cfg.prefix_cache_bytes > 0:
             from tfservingcache_tpu.runtime.prefix_cache import PrefixCache
 
             self._prefix_cache = PrefixCache(self.cfg.prefix_cache_bytes)
@@ -533,8 +536,15 @@ class TPUModelRuntime(BaseRuntime):
         seed: int = 0,
         draft_model_id: ModelId | None = None,
         spec_tokens: int = 4,
+        prefix_rows: int | None = None,
     ) -> np.ndarray:
         """KV-cached autoregressive decoding (models/generation.py).
+
+        ``prefix_rows`` forces the prefix-cache decision (None = decide
+        locally): a cross-host group's leader decides once and ships the
+        decision in the work envelope so every process provably runs the
+        same program (0 = full prefill, N = reuse exactly N cached rows; a
+        follower that cannot honor N raises before any device op).
 
         Prompt seq, max_new_tokens AND the batch axis are padded to
         power-of-two buckets so one compiled generate program serves the
@@ -653,10 +663,25 @@ class TPUModelRuntime(BaseRuntime):
                 )
             else:
                 toks = None
-                if self._prefix_cache is not None and ids.shape[0] == 1:
+                prefix_capable = (
+                    self._prefix_cache is not None and ids.shape[0] == 1
+                )
+                if prefix_rows is not None and prefix_rows > 0 and not prefix_capable:
+                    # a forced hit this process cannot even attempt must fail
+                    # LOUDLY before any device op — silently falling through
+                    # to full prefill would enter a different program than
+                    # the leader's suffix-prefill collective
+                    raise RuntimeError_(
+                        f"prefix-cache divergence for {model_id}: leader "
+                        f"decided {prefix_rows} cached rows but this process "
+                        "has no prefix cache (prefix_cache_bytes mismatch "
+                        "across the group?)"
+                    )
+                if prefix_capable:
                     toks = self._prefix_generate(
                         loaded, model_id, ids, int(lengths[0]), new_bucket,
                         max_new_tokens, temperature, top_k, seed,
+                        forced_rows=prefix_rows,
                     )
                 if toks is None:
                     toks = gen(
@@ -669,20 +694,12 @@ class TPUModelRuntime(BaseRuntime):
                         top_k=top_k,
                         rng=jax.random.PRNGKey(seed),
                     )
-            if self._mp_mesh:
+            if self._mp_mesh and not isinstance(toks, np.ndarray):
                 # force the token array fully replicated so this process can
-                # read it (inferred output sharding may split it across hosts);
-                # all group processes execute this identity in lockstep. The
-                # jitted identity is cached — a fresh lambda per call would
-                # retrace and recompile per request
-                if self._replicate_out is None:
-                    from jax.sharding import NamedSharding, PartitionSpec
-
-                    self._replicate_out = jax.jit(
-                        lambda t: t,
-                        out_shardings=NamedSharding(self.mesh, PartitionSpec()),
-                    )
-                toks = self._replicate_out(toks)
+                # read it (inferred output sharding may split it across
+                # hosts); all group processes execute this identity in
+                # lockstep. The prefix path already returns host tokens.
+                toks = self._replicated(toks)
             toks = np.asarray(jax.device_get(toks))
         return toks[:b, :max_new_tokens]
 
@@ -729,13 +746,28 @@ class TPUModelRuntime(BaseRuntime):
     def is_loaded(self, model_id: ModelId) -> bool:
         return self._resident.get(model_id, touch=False) is not None
 
+    def _replicated(self, t):
+        """Jitted identity with fully-replicated out_sharding (cached — a
+        fresh lambda per call would retrace and recompile per request); all
+        group processes execute it in lockstep."""
+        import jax
+
+        if self._replicate_out is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._replicate_out = jax.jit(
+                lambda x: x,
+                out_shardings=NamedSharding(self.mesh, PartitionSpec()),
+            )
+        return self._replicate_out(t)
+
     def _spec_admit(self, target: ModelId, draft: ModelId) -> bool:
         """Should this request run its draft? False once sustained low
         acceptance disabled the pair; every SPEC_REPROBE_EVERY-th gated
         request re-auditions the draft so a workload shift can re-enable it.
         Group-served models never gate: leader and followers must execute
-        the SAME device program, and this gate's state is per-process (the
-        same reason the prefix cache is single-group only)."""
+        the SAME device program, and this gate's decision — unlike the
+        prefix cache's, which rides the work envelope — is not broadcast."""
         if self._mp_mesh:
             return True
         with self._spec_lock:
@@ -785,7 +817,8 @@ class TPUModelRuntime(BaseRuntime):
 
     def _prefix_generate(self, loaded, model_id, ids, prompt_len: int,
                          new_bucket: int, max_new: int, temperature: float,
-                         top_k: int, seed: int):
+                         top_k: int, seed: int,
+                         forced_rows: int | None = None):
         """B=1 generate through the prefix KV cache: reuse the longest
         cached token-prefix's K/V rows, prefill only the suffix, and store
         the (prompt + completion) rows for the next turn. Output matches the
@@ -794,7 +827,12 @@ class TPUModelRuntime(BaseRuntime):
         suffix-only prefill is a different matmul shape, so near-tied
         argmax/sampling under accelerator float reassociation can differ
         between hit and miss (same caveat as models/speculative.py); don't
-        rely on seed-reproducibility across cache state."""
+        rely on seed-reproducibility across cache state.
+
+        ``forced_rows`` (group mode): the leader's decision from the work
+        envelope. Every process must run the SAME program, so a forced hit
+        this cache cannot honor raises — BEFORE any device op — instead of
+        silently prefilling a different shape into the group's collective."""
         import jax
 
         from tfservingcache_tpu.models.generation import (
@@ -805,7 +843,24 @@ class TPUModelRuntime(BaseRuntime):
         pc = self._prefix_cache
         prompt = ids[0, :prompt_len]
         rng = jax.random.PRNGKey(seed)
-        hit = pc.lookup(model_id, prompt)
+        if forced_rows == 0:
+            hit = None
+            pc.note_forced_miss()
+        else:
+            hit = pc.lookup(model_id, prompt)
+        if forced_rows is not None and forced_rows > 0:
+            if hit is None or hit.valid_len < forced_rows:
+                raise RuntimeError_(
+                    f"prefix-cache divergence for {model_id}: leader decided "
+                    f"{forced_rows} cached rows, this process has "
+                    f"{0 if hit is None else hit.valid_len} — group states "
+                    "are out of lockstep (re-form required)"
+                )
+            if hit.valid_len > forced_rows:
+                from tfservingcache_tpu.runtime.prefix_cache import PrefixEntry
+
+                hit = PrefixEntry(hit.tokens[:forced_rows], hit.k, hit.v,
+                                  forced_rows, hit.nbytes)
         if hit is None:
             toks_d, k_full, v_full = gen(
                 loaded.model_def, loaded.params, ids,
@@ -826,6 +881,11 @@ class TPUModelRuntime(BaseRuntime):
                 temperature=temperature, top_k=top_k, rng=rng,
                 return_cache=True,
             )
+        if self._mp_mesh:
+            # sharded result: force replication so THIS process can read the
+            # tokens (same jitted identity the plain path uses); K/V stay
+            # sharded — each process caches its own shards
+            toks_d = self._replicated(toks_d)
         toks = np.asarray(jax.device_get(toks_d))
         # every emitted token's K/V row was written (the scan forwards the
         # carry token before sampling the next), so rows are valid through
@@ -904,6 +964,21 @@ class TPUModelRuntime(BaseRuntime):
 
     def resident_models(self) -> list[ModelId]:
         return self._resident.keys_mru_first()
+
+    def reset_group_state(self) -> None:
+        """Drop every resident model plus the prefix KV and draft-acceptance
+        histories — the clean slate a re-forming cross-host group resets to
+        (parallel/multihost.py): after a follower death the survivors' (or a
+        restarted follower's empty) states must match EXACTLY before the
+        lockstep op stream resumes; re-deriving parity is impossible, so the
+        group re-forms empty and cold-loads on demand like the reference's
+        remapped ring keys (SURVEY §3.4)."""
+        for mid in self.resident_models():
+            self._resident.remove(mid, run_callback=True)
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
+        with self._spec_lock:
+            self._spec_health.clear()
 
     def _update_gauges(self) -> None:
         if self.metrics is None:

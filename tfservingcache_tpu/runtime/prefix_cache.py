@@ -11,10 +11,12 @@ encode; a lookup matches the longest cached entry whose tokens are a
 prefix of the new prompt, token-for-token (no hash-collision risk).
 
 Byte-budgeted LRU, OFF by default (``serving.prefix_cache_bytes = 0``):
-entries hold real HBM. Single-group runtimes only — a cross-host group's
-leader and followers could disagree on hits and diverge their op streams.
-Entries are bucketed per model so one tenant's scan never pays for
-another's, and ``drop_model`` is O(that model's entries).
+entries hold real HBM. Cross-host groups are supported (VERDICT r5 #7):
+each process caches its own K/V shards, the LEADER's hit decision rides the
+work envelope (``peek`` + ``generate(prefix_rows=...)``) so every process
+provably runs the same program, and group re-formation resets all caches to
+empty together. Entries are bucketed per model so one tenant's scan never
+pays for another's, and ``drop_model`` is O(that model's entries).
 """
 
 from __future__ import annotations
@@ -57,27 +59,38 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
 
+    def _best_match(self, model_id: ModelId,
+                    prompt: np.ndarray) -> tuple[bytes | None, int]:
+        """(backing key, usable rows) of the longest entry whose tokens are
+        a STRICT prefix of ``prompt`` (strict: at least one suffix token must
+        remain to prefill — the forward needs a non-empty block). Callers
+        hold the lock. The ONE matching rule: ``lookup`` (mutating) and
+        ``peek`` (the group leader's envelope decision) must never diverge,
+        so they share this."""
+        best_tok, best = None, 0
+        for tok_bytes, ent in self._by_model.get(model_id, {}).items():
+            usable = min(ent.valid_len, prompt.shape[0] - 1)
+            if usable < 1 or usable <= best:
+                continue
+            if np.array_equal(ent.tokens[:usable], prompt[:usable]):
+                best_tok, best = tok_bytes, usable
+        return best_tok, best
+
     def lookup(self, model_id: ModelId, prompt: np.ndarray) -> PrefixEntry | None:
-        """Longest entry whose tokens are a strict prefix of ``prompt``
-        (strict: at least one suffix token must remain to prefill — the
-        forward needs a non-empty block)."""
+        """Longest strict-prefix entry (see _best_match), counted + touched."""
         prompt = np.asarray(prompt, np.int32)
-        best: PrefixEntry | None = None
-        best_tok: bytes | None = None
         with self._lock:
-            for tok_bytes, ent in self._by_model.get(model_id, {}).items():
-                usable = min(ent.valid_len, prompt.shape[0] - 1)
-                if usable < 1 or (best is not None and usable <= best.valid_len):
-                    continue
-                if np.array_equal(ent.tokens[:usable], prompt[:usable]):
-                    if usable < ent.valid_len:
-                        # partially usable entry: present it at the usable
-                        # length (rows beyond it are junk the suffix prefill
-                        # overwrites)
-                        ent = PrefixEntry(ent.tokens[:usable], ent.k, ent.v,
-                                          usable, ent.nbytes)
-                    best = ent
-                    best_tok = tok_bytes  # the BACKING key, not the view's
+            best_tok, usable = self._best_match(model_id, prompt)
+            best: PrefixEntry | None = None
+            if best_tok is not None:
+                ent = self._by_model[model_id][best_tok]
+                if usable < ent.valid_len:
+                    # partially usable entry: present it at the usable
+                    # length (rows beyond it are junk the suffix prefill
+                    # overwrites)
+                    ent = PrefixEntry(ent.tokens[:usable], ent.k, ent.v,
+                                      usable, ent.nbytes)
+                best = ent
             if best is not None:
                 self._recency.move_to_end((model_id, best_tok))
                 # keep the per-model order LRU too: the entry cap below
@@ -114,6 +127,21 @@ class PrefixCache:
                 ev_tok, ev = model_entries.popitem(last=False)
                 self._total -= ev.nbytes
                 self._recency.pop((model_id, ev_tok), None)
+
+    def peek(self, model_id: ModelId, prompt: np.ndarray) -> int:
+        """Usable row count of the best entry for ``prompt`` WITHOUT touching
+        recency or hit/miss counters (0 = miss). A cross-host group's leader
+        peeks under its op lock to form the envelope decision; the real
+        lookup happens inside generate on every process."""
+        prompt = np.asarray(prompt, np.int32)
+        with self._lock:
+            return self._best_match(model_id, prompt)[1]
+
+    def note_forced_miss(self) -> None:
+        """Stats for a miss decided upstream (group envelope forced_rows=0):
+        the local lookup was bypassed, the miss still happened."""
+        with self._lock:
+            self.misses += 1
 
     def drop_model(self, model_id: ModelId) -> None:
         """Model unloaded/evicted: its prefix KV must go with it."""

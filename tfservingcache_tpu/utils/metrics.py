@@ -120,6 +120,11 @@ class Metrics:
             "Device bytes held by cached prompt-prefix KV entries",
             registry=r,
         )
+        self.group_reforms = Counter(
+            "tpusc_group_reform_events_total",
+            "Cross-host group failure-containment events",
+            ["group", "event"], registry=r,  # event: torn_down | reformed
+        )
         self.spec_draft_autodisabled = Counter(
             "tpusc_spec_draft_autodisabled_total",
             "Draft models auto-disabled after sustained low acceptance",
