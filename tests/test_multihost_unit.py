@@ -317,6 +317,88 @@ async def test_wedged_follower_timeout_contains_group(monkeypatch):
         await srv.close()
 
 
+async def test_leader_gates_group_draft_on_low_acceptance(tmp_path):
+    """The draft-acceptance auto-disable works for cross-host groups via the
+    leader-decides pattern: after sustained low acceptance the envelope
+    ships NO draft (followers run the identical plain program), and output
+    stays exact throughout."""
+    import jax
+    import numpy as np
+
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.models.registry import (
+        build,
+        export_artifact,
+        save_artifact,
+    )
+    from tfservingcache_tpu.runtime.model_runtime import SPEC_DISABLE_AFTER
+
+    cfg_t = {
+        "vocab_size": 128, "d_model": 64, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 128, "max_seq": 128,
+        "rope_theta": 10000.0, "dtype": "float32",
+    }
+    cfg_d = dict(cfg_t, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+                 d_ff=64)
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="target", version=1,
+                    seed=0, config=cfg_t)
+    md = build("transformer_lm", cfg_d)
+    zeros = jax.tree_util.tree_map(
+        lambda x: np.zeros_like(np.asarray(x)), md.init(jax.random.PRNGKey(9))
+    )
+    save_artifact(str(store / "adver" / "1"), md, zeros)
+
+    class _EnvelopeRuntime(_RecordingRuntime):
+        drafts = []
+
+        def generate(self, mid, ids, **kw):
+            self.drafts.append(kw.get("draft_model_id"))
+            return np.zeros((1, 4), np.int32)
+
+    handler = GroupWorkHandler()
+    rt_f = _EnvelopeRuntime()
+    handler.register(0, _RecordingManager(), rt_f)
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    leader = MultiHostGroupRuntime(
+        ServingConfig(platform="cpu"),
+        followers=[f"127.0.0.1:{port}"],
+        group_index=0,
+    )
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        leader,
+    )
+    loop = asyncio.get_running_loop()
+    try:
+        big, adv = ModelId("target", 1), ModelId("adver", 1)
+        await loop.run_in_executor(None, manager.ensure_servable, big)
+        await loop.run_in_executor(None, manager.ensure_servable, adv)
+        rng = np.random.default_rng(5)
+        for i in range(SPEC_DISABLE_AFTER + 2):
+            ids = rng.integers(1, 128, (1, 8)).astype(np.int32)
+            ref = await loop.run_in_executor(None, lambda: leader.generate(
+                big, ids, max_new_tokens=12, temperature=0.0))
+            got = await loop.run_in_executor(None, lambda: leader.generate(
+                big, ids, max_new_tokens=12, temperature=0.0,
+                draft_model_id=adv))
+            np.testing.assert_array_equal(got, ref)
+        assert leader._spec_health[(big, adv)]["disabled"]
+        # the follower's envelopes show the gate flip: draft present early,
+        # absent once disabled
+        draft_envs = [d for d in rt_f.drafts if d is not None]
+        assert ModelId("adver", 1) in draft_envs
+        assert rt_f.drafts[-1] is None, rt_f.drafts[-3:]
+    finally:
+        leader.close()
+        await srv.close()
+        manager.close()
+
+
 async def test_follower_drops_expired_queued_prefetch_only():
     """A PREFETCH whose budget elapsed while queued fails fast (the leader
     abandoned it), but collective ops must run however late — the leader has

@@ -267,6 +267,10 @@ class MultiHostGroupRuntime(TPUModelRuntime):
         # from the pre-teardown era (a slow timeout resolving after the
         # group already re-formed) must not re-tear-down the new group
         self._epoch = 0
+        # the LEADER owns the group's draft-acceptance gate: its admit
+        # decision rides the envelope (a gated request simply ships no
+        # draft), so followers never need gate state of their own
+        self._spec_gate_active = True
 
     # -- broadcast plumbing -------------------------------------------------
     def _post(self, addr: str, body: bytes,
@@ -317,6 +321,10 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             epoch = self._epoch
 
             def _watch(f):
+                # close() cancels queued futures; .exception() on those
+                # raises CancelledError inside the callback
+                if f.cancelled():
+                    return
                 if isinstance(f.exception(), FollowerUnreachable):
                     self._mark_unhealthy(
                         f"follower died during a collective: {f.exception()}",
@@ -536,14 +544,29 @@ class MultiHostGroupRuntime(TPUModelRuntime):
         # provably runs the same program. A follower whose cache cannot
         # honor it raises before any device op (lockstep divergence -> the
         # containment path tears the group down for a reset).
-        decision = {"rows": None}
+        decision = {"rows": -1, "use_draft": draft_model_id is not None}
 
         def meta() -> dict:
+            # the group's draft-acceptance gate (leader-decides, VERDICT r5
+            # #6's group extension): a gated request ships NO draft, so
+            # every process runs the identical plain program
+            if draft_model_id is not None:
+                decision["use_draft"] = self._spec_admit(
+                    model_id, draft_model_id
+                )
+            use = decision["use_draft"]
+            # ALWAYS ship an explicit decision: peeked rows (>= 0, run the
+            # prefix machinery) or -1 (run the cache-less plain path). A
+            # follower must never "decide locally" — with mixed
+            # prefix_cache_bytes configs that silently enters a different
+            # program than the leader's (miss-path gen carries
+            # return_cache; plain gen does not).
+            decision["rows"] = -1
             if (
                 self._prefix_cache is not None
                 and ids.ndim == 2
                 and ids.shape[0] == 1
-                and draft_model_id is None
+                and not use
                 # malformed prompt_lengths must reach generate's own
                 # validation (clean 400), not crash the peek with IndexError
                 and lengths.shape == (1,)
@@ -558,8 +581,8 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 "temperature": temperature, "top_k": top_k, "seed": seed,
                 # followers must replay the SAME speculative program: the
                 # draft's forwards are collectives too on a sharded group
-                "draft_model": draft_model_id.name if draft_model_id else "",
-                "draft_version": draft_model_id.version if draft_model_id else 0,
+                "draft_model": draft_model_id.name if (draft_model_id and use) else "",
+                "draft_version": draft_model_id.version if (draft_model_id and use) else 0,
                 "spec_tokens": spec_tokens,
                 "prefix_rows": decision["rows"],
             }
@@ -570,38 +593,24 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             lambda: super(MultiHostGroupRuntime, self).generate(
                 model_id, ids, prompt_lengths=list(lengths),
                 max_new_tokens=max_new_tokens, temperature=temperature,
-                top_k=top_k, seed=seed, draft_model_id=draft_model_id,
+                top_k=top_k, seed=seed,
+                draft_model_id=draft_model_id if decision["use_draft"] else None,
                 spec_tokens=spec_tokens, prefix_rows=decision["rows"],
+                spec_admitted=True if decision["use_draft"] and draft_model_id else None,
             ),
         )
 
     def unload(self, model_id) -> None:
         # unload holds no collectives, but followers must mirror it so the
         # group's LRU states stay in lockstep (divergent eviction would make
-        # a later follower re-load run its warmup collective solo)
-        self._require_healthy()
-        self._acquire_group_lock()
-        try:
-            # collective=True: a failed follower unload diverges the group's
-            # LRU lockstep (a later re-load would run its warmup solo)
-            futures = self._broadcast(
-                {"op": "unload", "model": model_id.name,
-                 "version": model_id.version},
-                collective=True,
-            )
-            super().unload(model_id)
-            try:
-                self._join(futures)
-            except RuntimeError:
-                # leader unloaded, a live follower didn't: divergent LRU
-                # states would run a later warmup collective solo
-                self._mark_unhealthy(
-                    "follower failed an unload the leader completed "
-                    "(LRU states diverged)"
-                )
-                raise
-        finally:
-            self._group_lock.release()
+        # a later follower re-load run its warmup collective solo) — same
+        # fire/compute/join + divergence classification as any collective op
+        self._run_collective(
+            {"op": "unload", "model": model_id.name,
+             "version": model_id.version},
+            None,
+            lambda: super(MultiHostGroupRuntime, self).unload(model_id),
+        )
 
     def close(self) -> None:
         self._closing.set()

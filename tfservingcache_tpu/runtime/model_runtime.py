@@ -247,9 +247,13 @@ class TPUModelRuntime(BaseRuntime):
 
             self._prefix_cache = PrefixCache(self.cfg.prefix_cache_bytes)
         # speculative acceptance gate (_spec_admit/_spec_observe): per
-        # (target, draft) low-acceptance streaks and disabled flags
+        # (target, draft) low-acceptance streaks and disabled flags.
+        # Active on single-process runtimes; a multi-process FOLLOWER keeps
+        # it off (it obeys the envelope), and the group LEADER re-activates
+        # it (multihost.py) to decide for the whole group.
         self._spec_health: dict[tuple[ModelId, ModelId], dict] = {}
         self._spec_lock = threading.Lock()
+        self._spec_gate_active = not self._mp_mesh
         # One jitted apply per (family, config) build key: all tenants of a
         # family share one XLA executable — tenant N's cold load is
         # params-transfer only. Entries are refcounted by resident models and
@@ -537,6 +541,7 @@ class TPUModelRuntime(BaseRuntime):
         draft_model_id: ModelId | None = None,
         spec_tokens: int = 4,
         prefix_rows: int | None = None,
+        spec_admitted: bool | None = None,
     ) -> np.ndarray:
         """KV-cached autoregressive decoding (models/generation.py).
 
@@ -557,6 +562,10 @@ class TPUModelRuntime(BaseRuntime):
         per round, this model verifies them in one chunked forward; output
         is bit-identical to its own greedy decode. Requires temperature 0
         and a loaded draft sharing the vocabulary.
+
+        ``spec_admitted=True`` marks the draft-acceptance gate as already
+        decided upstream (the group leader admits once in its envelope
+        builder; re-admitting here would double-count the reprobe cadence).
         """
         import math as _math
 
@@ -634,8 +643,10 @@ class TPUModelRuntime(BaseRuntime):
             "generate", model=str(model_id), tokens=new_bucket, batch=b,
             draft=str(draft_model_id) if draft_model_id else "",
         ):
-            if draft is not None and not self._spec_admit(
-                model_id, draft_model_id
+            if (
+                draft is not None
+                and spec_admitted is None
+                and not self._spec_admit(model_id, draft_model_id)
             ):
                 # sustained low acceptance: the draft is pure overhead, fall
                 # back to plain greedy decode (identical output) until the
@@ -666,17 +677,23 @@ class TPUModelRuntime(BaseRuntime):
                 prefix_capable = (
                     self._prefix_cache is not None and ids.shape[0] == 1
                 )
-                if prefix_rows is not None and prefix_rows > 0 and not prefix_capable:
-                    # a forced hit this process cannot even attempt must fail
-                    # LOUDLY before any device op — silently falling through
-                    # to full prefill would enter a different program than
-                    # the leader's suffix-prefill collective
-                    raise RuntimeError_(
-                        f"prefix-cache divergence for {model_id}: leader "
-                        f"decided {prefix_rows} cached rows but this process "
-                        "has no prefix cache (prefix_cache_bytes mismatch "
-                        "across the group?)"
-                    )
+                if prefix_rows is not None:
+                    if prefix_rows < 0:
+                        # the leader runs the cache-LESS plain path (no
+                        # return_cache, no insert): this process must run
+                        # the identical program even if it has a cache
+                        prefix_capable = False
+                    elif not prefix_capable:
+                        # a forced prefix-machinery decision (miss included:
+                        # its gen runs with return_cache, a different
+                        # program than plain) this process cannot attempt
+                        # must fail LOUDLY before any device op
+                        raise RuntimeError_(
+                            f"prefix-cache divergence for {model_id}: leader "
+                            f"decided {prefix_rows} cached rows but this "
+                            "process cannot run the prefix path "
+                            "(prefix_cache_bytes mismatch across the group?)"
+                        )
                 if prefix_capable:
                     toks = self._prefix_generate(
                         loaded, model_id, ids, int(lengths[0]), new_bucket,
@@ -765,10 +782,10 @@ class TPUModelRuntime(BaseRuntime):
         """Should this request run its draft? False once sustained low
         acceptance disabled the pair; every SPEC_REPROBE_EVERY-th gated
         request re-auditions the draft so a workload shift can re-enable it.
-        Group-served models never gate: leader and followers must execute
-        the SAME device program, and this gate's decision — unlike the
-        prefix cache's, which rides the work envelope — is not broadcast."""
-        if self._mp_mesh:
+        On a cross-host group only the LEADER holds an active gate — its
+        decision rides the work envelope (draft dropped when gated), so
+        every process still executes the same program."""
+        if not self._spec_gate_active:
             return True
         with self._spec_lock:
             st = self._spec_health.get((target, draft))
@@ -785,7 +802,7 @@ class TPUModelRuntime(BaseRuntime):
         tpr = emitted / max(1, rounds)
         if self.metrics is not None:
             self.metrics.spec_tokens_per_round.set(round(tpr, 3))
-        if self._mp_mesh:
+        if not self._spec_gate_active:
             return
         with self._spec_lock:
             st = self._spec_health.setdefault(
