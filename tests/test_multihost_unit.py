@@ -171,7 +171,10 @@ async def test_follower_timeout_tied_to_request_deadline():
             futures = leader._broadcast(
                 {"op": "prefetch", "model": "m", "version": 1}
             )
-            with pytest.raises(RuntimeError, match="follower"):
+            # transport death is typed: retriable-elsewhere for the client
+            from tfservingcache_tpu.runtime.base import GroupUnhealthyError
+
+            with pytest.raises(GroupUnhealthyError, match="follower"):
                 await asyncio.get_running_loop().run_in_executor(
                     None, leader._join, futures
                 )
@@ -230,9 +233,10 @@ async def test_group_failure_containment_and_reformation(tmp_path, monkeypatch):
         ))
         assert await loop.run_in_executor(None, manager.is_healthy)
 
-        # kill the follower mid-stream
+        # kill the follower mid-stream: the TRIGGERING request already
+        # gets the retriable 503-mapped error, not a raw 500
         await srv.close()
-        with pytest.raises(RuntimeError, match="followers failed"):
+        with pytest.raises(GroupUnhealthyError, match="followers failed"):
             await loop.run_in_executor(None, lambda: leader._run_collective(
                 {"op": "ensure", "model": "m", "version": 1}, None,
                 lambda: None,
@@ -287,6 +291,8 @@ async def test_wedged_follower_timeout_contains_group(monkeypatch):
 
     monkeypatch.setattr(mh, "REFORM_PROBE_PERIOD_S", 0.2)
 
+    from tfservingcache_tpu.runtime.base import GroupUnhealthyError
+
     class _WedgedManager(_RecordingManager):
         def ensure_servable(self, mid):
             _time.sleep(8.0)  # stuck mid-collective (short enough to unwind at exit)
@@ -302,7 +308,7 @@ async def test_wedged_follower_timeout_contains_group(monkeypatch):
     )
     loop = asyncio.get_running_loop()
     try:
-        with pytest.raises(RuntimeError, match="followers failed"):
+        with pytest.raises(GroupUnhealthyError, match="followers failed"):
             await loop.run_in_executor(None, lambda: leader._run_collective(
                 {"op": "ensure", "model": "m", "version": 1}, None,
                 lambda: None,
@@ -397,6 +403,82 @@ async def test_leader_gates_group_draft_on_low_acceptance(tmp_path):
         leader.close()
         await srv.close()
         manager.close()
+
+
+async def test_symmetric_validation_failure_keeps_leader_error_type():
+    """A malformed request rejected by EVERY process (leader + followers,
+    same validation, before device work) must surface the leader's TYPED
+    error — RuntimeError_ maps to 400 — not a builtin RuntimeError from the
+    follower join (which would 500 a plain bad request), and must NOT tear
+    the group down."""
+    from tfservingcache_tpu.runtime.base import RuntimeError_
+
+    class _RejectingManager(_RecordingManager):
+        def ensure_servable(self, mid):
+            raise ValueError("bad temperature")  # app-level 500 on follower
+
+    handler = GroupWorkHandler()
+    handler.register(0, _RejectingManager(), _RecordingRuntime())
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    leader = MultiHostGroupRuntime(
+        ServingConfig(platform="cpu"),
+        followers=[f"127.0.0.1:{port}"],
+        group_index=0,
+    )
+    loop = asyncio.get_running_loop()
+    try:
+        def op():
+            def fn():
+                raise RuntimeError_("temperature must be >= 0")
+            leader._run_collective(
+                {"op": "ensure", "model": "m", "version": 1}, None, fn
+            )
+        with pytest.raises(RuntimeError_, match="temperature"):
+            await loop.run_in_executor(None, op)
+        assert leader._unhealthy_reason is None  # symmetric != group death
+    finally:
+        leader.close()
+        await srv.close()
+
+
+async def test_config_mismatch_blocks_group_and_reformation(monkeypatch):
+    """serving.prefix_cache_bytes differing across a group is a PERMANENT
+    misconfiguration: the follower rejects every envelope (including the
+    reform ping) with a clear error, so the group fails once and stays
+    down-with-reason instead of churning teardown/re-form forever."""
+    from tfservingcache_tpu.parallel import multihost as mh
+
+    monkeypatch.setattr(mh, "REFORM_PROBE_PERIOD_S", 0.2)
+    handler = GroupWorkHandler()
+    rt_f = _RecordingRuntime()  # no _prefix_cache attr -> follower cache off
+    handler.register(0, _RecordingManager(), rt_f)
+    srv = GroupWorkServer(handler)
+    port = await srv.start(0, host="127.0.0.1")
+    leader = MultiHostGroupRuntime(
+        ServingConfig(platform="cpu", prefix_cache_bytes=1 << 20),
+        followers=[f"127.0.0.1:{port}"],
+        group_index=0,
+    )
+    from tfservingcache_tpu.runtime.base import GroupUnhealthyError
+
+    loop = asyncio.get_running_loop()
+    try:
+        # surfaces as the retriable 503-mapped error, cause text preserved
+        with pytest.raises(GroupUnhealthyError, match="config mismatch"):
+            await loop.run_in_executor(None, lambda: leader._run_collective(
+                {"op": "ensure", "model": "m", "version": 1}, None,
+                lambda: None,
+            ))
+        # one divergence teardown...
+        assert leader._unhealthy_reason is not None
+        # ...and re-formation stays BLOCKED (the ping carries the same cfg
+        # fingerprint and the follower keeps rejecting it)
+        await asyncio.sleep(1.2)
+        assert leader._unhealthy_reason is not None
+    finally:
+        leader.close()
+        await srv.close()
 
 
 async def test_follower_drops_expired_queued_prefetch_only():

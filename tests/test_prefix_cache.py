@@ -155,6 +155,67 @@ def test_two_turn_conversation_exact(stacks, temp, top_k, seed, max_new):
     np.testing.assert_array_equal(t2_again, t2_off)
 
 
+def test_moe_family_prefix_and_speculative_exactness(tmp_path):
+    """The generate-path features must cover BOTH decoder-LM families: a
+    moe_lm 2-turn conversation through the prefix cache, and moe_lm as a
+    speculative-decoding target, each token-exact vs the plain path
+    (float32 — expert routing is batch-composition dependent, so B=1 solo
+    paths are the exactness surface)."""
+    from tfservingcache_tpu.models.registry import export_artifact as exp
+
+    moe_cfg = {
+        "vocab_size": 97, "d_model": 32, "n_layers": 2, "n_heads": 4,
+        "n_kv_heads": 2, "d_ff": 64, "n_experts": 4, "capacity_factor": 2.0,
+        "aux_loss_weight": 0.01, "max_seq": 128, "dtype": "float32",
+    }
+    store = tmp_path / "store"
+    exp("moe_lm", str(store), name="moe", version=1, seed=0, config=moe_cfg)
+    exp("moe_lm", str(store), name="draft", version=1, seed=1,
+        config=dict(moe_cfg, d_model=16, n_layers=1, n_heads=2,
+                    n_kv_heads=1, d_ff=32))
+
+    runtime = TPUModelRuntime(ServingConfig(prefix_cache_bytes=64 << 20))
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime,
+    )
+    rt_plain = TPUModelRuntime(ServingConfig())
+    mgr_plain = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache2"), capacity_bytes=1 << 30),
+        rt_plain,
+    )
+    try:
+        mid, draft = ModelId("moe", 1), ModelId("draft", 1)
+        for m in (manager, mgr_plain):
+            m.ensure_servable(mid)
+            m.ensure_servable(draft)
+        rng = np.random.default_rng(7)
+        prompt = rng.integers(0, 97, 24).astype(np.int32).tolist()
+        t1 = runtime.generate(mid, np.asarray([prompt], np.int32),
+                              max_new_tokens=8, seed=3)
+        w1 = rt_plain.generate(mid, np.asarray([prompt], np.int32),
+                               max_new_tokens=8, seed=3)
+        np.testing.assert_array_equal(t1, w1)
+        turn2 = prompt + t1[0].tolist() + [5, 6]
+        t2 = runtime.generate(mid, np.asarray([turn2], np.int32),
+                              max_new_tokens=8, seed=3)
+        w2 = rt_plain.generate(mid, np.asarray([turn2], np.int32),
+                               max_new_tokens=8, seed=3)
+        assert runtime._prefix_cache.hits >= 1
+        np.testing.assert_array_equal(t2, w2)
+        # moe target + moe draft speculative == moe plain greedy
+        ids = np.asarray([turn2], np.int32)
+        ref = rt_plain.generate(mid, ids, max_new_tokens=10, temperature=0.0)
+        got = rt_plain.generate(mid, ids, max_new_tokens=10, temperature=0.0,
+                                draft_model_id=draft)
+        np.testing.assert_array_equal(got, ref)
+    finally:
+        manager.close()
+        mgr_plain.close()
+
+
 def test_prefix_entries_dropped_on_unload(stacks):
     _, rt = stacks(64 << 20)
     mid = ModelId("m", 1)

@@ -98,6 +98,23 @@ class GroupWorkHandler:
         gi = int(meta["group"])
         manager, runtime = self._groups[gi]
         op = meta["op"]
+        # program-affecting config must match across the group: a mismatch
+        # (e.g. prefix_cache_bytes on the leader, off here) would run
+        # DIFFERENT XLA programs into one collective. Checked on every
+        # envelope — including ping, so a misconfigured group's re-formation
+        # stays blocked with a clear error instead of churning
+        # teardown/reform forever (one permanent misconfiguration = one
+        # permanent, explained, out-of-ring group).
+        cfg = meta.get("cfg")
+        if cfg is not None:
+            mine = getattr(runtime, "_prefix_cache", None) is not None
+            if bool(cfg.get("prefix_cache")) != mine:
+                raise RuntimeError(
+                    f"group {gi} config mismatch: leader prefix_cache="
+                    f"{bool(cfg.get('prefix_cache'))}, this process={mine} — "
+                    "serving.prefix_cache_bytes must match on every process "
+                    "of a cross-host group"
+                )
         if op == "ping":
             # reform probe: alive AND able to take the group lock soon — a
             # follower wedged mid-op answers "busy", so the leader keeps the
@@ -302,8 +319,12 @@ class MultiHostGroupRuntime(TPUModelRuntime):
     def _broadcast(self, meta: dict, arrays: Mapping[str, np.ndarray] | None = None,
                    collective: bool = False):
         # budget_s lets the follower drop items that expire while queued
-        # behind its group lock (the leader has long since 504'd them)
-        meta = dict(meta, group=self._group_index, budget_s=self._op_timeout_s)
+        # behind its group lock (the leader has long since 504'd them);
+        # cfg is the program-affecting fingerprint every follower validates
+        meta = dict(
+            meta, group=self._group_index, budget_s=self._op_timeout_s,
+            cfg={"prefix_cache": self._prefix_cache is not None},
+        )
         body = encode_work(meta, arrays)
         futures = [
             self._bcast_pool.submit(self._post, addr, body)
@@ -353,8 +374,14 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             if any(isinstance(e, FollowerUnreachable) for e in errs):
                 # a dead/wedged follower poisons the whole group's lockstep
                 # guarantee — contain it (fail fast + leave the ring) rather
-                # than let every request queue into the wedge
+                # than let every request queue into the wedge. The TRIGGERING
+                # request gets the same retriable 503 its successors will:
+                # replicas/other groups can absorb it right now
                 self._mark_unhealthy(msg)
+                raise GroupUnhealthyError(
+                    f"cross-host group {self._group_index} lost a follower "
+                    f"({msg}); retry against a replica"
+                )
             raise RuntimeError(msg)
 
     # -- failure containment / re-formation ---------------------------------
@@ -396,6 +423,10 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 ping = encode_work({
                     "op": "ping", "group": self._group_index,
                     "lock_timeout_s": 0.5,
+                    # mismatched config blocks re-formation HERE, with the
+                    # handler's clear error in the "still down" log, instead
+                    # of churning teardown/reform on every request
+                    "cfg": {"prefix_cache": self._prefix_cache is not None},
                 })
                 for addr in self._followers:
                     self._post(addr, ping, timeout_s=PING_TIMEOUT_S)
@@ -471,24 +502,39 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             )
             try:
                 result = fn()
-            except BaseException:
+            except BaseException as leader_err:
                 # the leader's half ALSO failed: a symmetric failure (every
                 # process rejected the same bad request before device work)
                 # is an ordinary request error, not group death — transport
                 # deaths still mark via _join/_watch
-                self._join(futures)  # follower errors usually explain ours
-                raise
+                try:
+                    self._join(futures)
+                except GroupUnhealthyError:
+                    raise  # a dead follower trumps: retriable 503
+                except RuntimeError as fe:
+                    # symmetric app errors must not mask the leader's TYPED
+                    # exception (RuntimeError_ maps to 400; a builtin
+                    # RuntimeError would 500 a plain bad request)
+                    log.debug("followers failed the same op: %s", fe)
+                raise leader_err
             try:
                 self._join(futures)
-            except RuntimeError:
+            except RuntimeError as e:
                 # the leader completed the op but a LIVE follower failed it:
                 # the processes' states have diverged (one ran the op, one
-                # didn't) — the lockstep guarantee is gone, re-form
+                # didn't) — the lockstep guarantee is gone, re-form. (The
+                # transport-death case raised GroupUnhealthyError from _join
+                # already — RuntimeError_ is not a builtin RuntimeError, so
+                # it passes through untouched.)
                 self._mark_unhealthy(
                     "follower failed a collective op the leader completed "
                     "(states diverged)"
                 )
-                raise
+                raise GroupUnhealthyError(
+                    f"cross-host group {self._group_index} diverged on a "
+                    f"collective op ({e}); re-forming — retry against a "
+                    "replica"
+                ) from e
             return result
         finally:
             self._group_lock.release()
