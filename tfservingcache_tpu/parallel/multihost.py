@@ -288,6 +288,8 @@ class MultiHostGroupRuntime(TPUModelRuntime):
         # decision rides the envelope (a gated request simply ships no
         # draft), so followers never need gate state of their own
         self._spec_gate_active = True
+        if self.metrics is not None:
+            self.metrics.group_healthy.labels(str(group_index)).set(1)
 
     # -- broadcast plumbing -------------------------------------------------
     def _post(self, addr: str, body: bytes,
@@ -401,6 +403,9 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 self.metrics.group_reforms.labels(
                     str(self._group_index), "torn_down"
                 ).inc()
+                self.metrics.group_healthy.labels(
+                    str(self._group_index)
+                ).set(0)
             self._reform_thread = threading.Thread(
                 target=self._reform_loop, name="tpusc-reform", daemon=True
             )
@@ -467,6 +472,9 @@ class MultiHostGroupRuntime(TPUModelRuntime):
                 self.metrics.group_reforms.labels(
                     str(self._group_index), "reformed"
                 ).inc()
+                self.metrics.group_healthy.labels(
+                    str(self._group_index)
+                ).set(1)
             return
 
     def check(self) -> None:

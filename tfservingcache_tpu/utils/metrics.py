@@ -125,6 +125,11 @@ class Metrics:
             "Cross-host group failure-containment events",
             ["group", "event"], registry=r,  # event: torn_down | reformed
         )
+        self.group_healthy = Gauge(
+            "tpusc_group_healthy",
+            "1 while the cross-host group serves; 0 while torn down/re-forming",
+            ["group"], registry=r,
+        )
         self.spec_draft_autodisabled = Counter(
             "tpusc_spec_draft_autodisabled_total",
             "Draft models auto-disabled after sustained low acceptance",

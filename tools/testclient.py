@@ -58,7 +58,10 @@ async def main() -> int:
     g.add_argument("--metadata", action="store_true")
     g.add_argument(
         "--generate", metavar="JSON",
-        help='REST :generate body, e.g. \'{"input_ids": [[1,2,3]], "max_new_tokens": 8}\''
+        help='REST :generate body, e.g. \'{"input_ids": [[1,2,3]], '
+             '"max_new_tokens": 8}\'; also takes "draft_model"/"spec_tokens" '
+             '(speculative decoding) and benefits from the server prefix '
+             'cache on multi-turn prompts'
         " (--target must be a REST port for this verb)",
     )
     args = p.parse_args()
