@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import threading
 import urllib.parse
 import urllib.request
 
@@ -47,34 +48,42 @@ class GCSModelProvider(ObjectStoreProvider):
         # paying a metadata probe per request, but one transient failure on a
         # real TPU-VM must not downgrade the provider to anonymous forever
         self._no_metadata_until = 0.0
+        # load_model's download pool calls _bearer_token from several
+        # threads: exactly ONE refreshes an expired token (the rest wait for
+        # its result) — unsynchronized, all 8 would race the metadata server
+        # and one transient failure could downgrade its siblings' downloads
+        # of the same artifact to anonymous mid-flight
+        self._token_lock = threading.Lock()
 
     # -- auth ----------------------------------------------------------------
     def _bearer_token(self) -> str:
         env = os.environ.get("GCS_ACCESS_TOKEN", "")
         if env:
             return env
-        if self._token and time.monotonic() < self._token_expiry - 60:
+        with self._token_lock:
+            if self._token and time.monotonic() < self._token_expiry - 60:
+                return self._token
+            if time.monotonic() < self._no_metadata_until:
+                return ""
+            req = urllib.request.Request(
+                _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
+            )
+            try:
+                status, _, body = http_call(req, timeout=2.0, retries=1)
+            except ProviderError:
+                self._no_metadata_until = time.monotonic() + _METADATA_RETRY_S
+                return ""  # not on GCP (or transient blip): anonymous for a while
+            if status != 200:
+                # negative-cache non-200 too (e.g. 404 when the instance has
+                # no default service account): without it every list page and
+                # object download would serially repeat the metadata
+                # round-trip
+                self._no_metadata_until = time.monotonic() + _METADATA_RETRY_S
+                return ""
+            tok = json.loads(body)
+            self._token = tok.get("access_token", "")
+            self._token_expiry = time.monotonic() + float(tok.get("expires_in", 0))
             return self._token
-        if time.monotonic() < self._no_metadata_until:
-            return ""
-        req = urllib.request.Request(
-            _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
-        )
-        try:
-            status, _, body = http_call(req, timeout=2.0, retries=1)
-        except ProviderError:
-            self._no_metadata_until = time.monotonic() + _METADATA_RETRY_S
-            return ""  # not on GCP (or transient blip): anonymous for a while
-        if status != 200:
-            # negative-cache non-200 too (e.g. 404 when the instance has no
-            # default service account): without it every list page and object
-            # download would serially repeat the metadata round-trip
-            self._no_metadata_until = time.monotonic() + _METADATA_RETRY_S
-            return ""
-        tok = json.loads(body)
-        self._token = tok.get("access_token", "")
-        self._token_expiry = time.monotonic() + float(tok.get("expires_in", 0))
-        return self._token
 
     def _request(self, url: str) -> urllib.request.Request:
         req = urllib.request.Request(url)

@@ -41,6 +41,9 @@ log = get_logger("providers.objectstore")
 
 _RETRIES = 3
 _RETRY_BACKOFF_S = 0.25
+# concurrent object downloads per artifact fetch (the reference is
+# sequential; see load_model)
+_DOWNLOAD_CONCURRENCY = 8
 
 
 @dataclass(frozen=True)
@@ -186,17 +189,57 @@ class ObjectStoreProvider(ModelProvider):
 
     # -- ModelProvider interface --------------------------------------------
     def load_model(self, name: str, version: int, dest_dir: str) -> Model:
+        """Fetch every object of the artifact, CONCURRENTLY (the reference
+        downloads sequentially, s3modelprovider.go:124-159 — per-object
+        round-trip latency then dominates a many-file artifact; a bounded
+        pool overlaps them, which is where the cold-miss seconds live for
+        object-store deployments). ``_download`` impls are stateless
+        (urllib + per-request auth), so calls are thread-safe."""
         objects, prefix = self._list_model_objects(name, version)
         total = 0
         with atomic_dest(dest_dir) as tmp:
+            work: list[tuple[ObjectInfo, str]] = []
             for obj in objects:
                 rel = obj.key[len(prefix):]
                 if not rel or rel.endswith("/"):
                     continue  # zero-byte "directory" placeholder objects
                 local = os.path.join(tmp, *rel.split("/"))
                 os.makedirs(os.path.dirname(local), exist_ok=True)
-                self._download(obj.key, local)
-                total += obj.size
+                work.append((obj, local))
+            if len(work) <= 1:
+                for obj, local in work:
+                    self._download(obj.key, local)
+                    total += obj.size
+            else:
+                from concurrent.futures import ThreadPoolExecutor, as_completed
+
+                with ThreadPoolExecutor(
+                    max_workers=min(_DOWNLOAD_CONCURRENCY, len(work)),
+                    thread_name_prefix="tpusc-fetch",
+                ) as pool:
+                    futures = {
+                        pool.submit(self._download, obj.key, local): obj
+                        for obj, local in work
+                    }
+                    first_err = None
+                    for f in as_completed(futures):
+                        try:
+                            f.result()
+                            total += futures[f].size
+                        except Exception as e:  # noqa: BLE001
+                            # fail fast: a multi-GB artifact must not keep
+                            # streaming its other objects (egress + the cold
+                            # deadline) after one of them already failed
+                            first_err = e
+                            pool.shutdown(wait=False, cancel_futures=True)
+                            break
+                    if first_err is not None:
+                        # atomic_dest discards the staging dir on raise: no
+                        # partial artifact ever lands at the final path
+                        raise ProviderError(
+                            f"object download failed (remaining downloads "
+                            f"cancelled): {first_err}"
+                        ) from first_err
         log.info("downloaded %s/%d: %d objects, %d bytes", name, version, len(objects), total)
         return Model(
             identifier=ModelId(name, version), path=dest_dir, size_on_disk=total

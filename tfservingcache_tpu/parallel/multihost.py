@@ -464,17 +464,19 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             with self._health_lock:
                 self._unhealthy_reason = None
                 self._epoch += 1  # invalidate stale pre-teardown signals
+                # gauge flips INSIDE the lock: a teardown racing this window
+                # must not be overwritten back to healthy afterwards
+                if self.metrics is not None:
+                    self.metrics.group_reforms.labels(
+                        str(self._group_index), "reformed"
+                    ).inc()
+                    self.metrics.group_healthy.labels(
+                        str(self._group_index)
+                    ).set(1)
             log.info(
                 "cross-host group %d re-formed (empty state) and rejoined "
                 "the ring", self._group_index,
             )
-            if self.metrics is not None:
-                self.metrics.group_reforms.labels(
-                    str(self._group_index), "reformed"
-                ).inc()
-                self.metrics.group_healthy.labels(
-                    str(self._group_index)
-                ).set(1)
             return
 
     def check(self) -> None:
@@ -668,5 +670,9 @@ class MultiHostGroupRuntime(TPUModelRuntime):
 
     def close(self) -> None:
         self._closing.set()
+        if self.metrics is not None:
+            # a closed group no longer serves: the gauge must not keep
+            # reporting healthy on a still-running metrics endpoint
+            self.metrics.group_healthy.labels(str(self._group_index)).set(0)
         self._bcast_pool.shutdown(wait=False, cancel_futures=True)
         super().close()
