@@ -112,6 +112,20 @@ async def main() -> None:
             lead_pc and (lead_pc.hits, lead_pc.misses)
         )
         print(f"PREFIX GROUP HIT OK hits={lead_pc.hits}", flush=True)
+        # draft-assisted turn on the SAME conversation: the leader's prefix
+        # decision rides the envelope into the cached-prefix SPECULATIVE
+        # path on every process (round-5 composition)
+        conv3 = conv2 + c2 + [11, 12]
+        hits_before = lead_pc.hits
+        async with s.post(
+            f"{base}:generate",
+            json={"input_ids": [conv3], "max_new_tokens": 8,
+                  "temperature": 0.0, "draft_model": "draft"},
+        ) as resp:
+            assert resp.status == 200, await resp.text()
+            c3 = (await resp.json())["tokens"][0]
+        assert lead_pc.hits > hits_before
+        print("SPEC PREFIX GROUP OK", flush=True)
 
     # parity vs an unsharded runtime on this process's local chips
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
@@ -152,6 +166,12 @@ async def main() -> None:
                       seed=7)
     np.testing.assert_array_equal(np.asarray([c2], np.int32), w2)
     assert rt1._prefix_cache.hits >= 1
+    # draft turn parity: the group's cached-prefix speculative output must
+    # equal the unsharded runtime's (same prefix state, same draft)
+    mgr1.ensure_servable(ModelId("draft", 1))
+    w3 = rt1.generate(mid, np.asarray([conv3], np.int32), max_new_tokens=8,
+                      temperature=0.0, draft_model_id=ModelId("draft", 1))
+    np.testing.assert_array_equal(np.asarray([c3], np.int32), w3)
     mgr1.close()
     await node.close()
     print("MULTIHOST PARITY OK", flush=True)

@@ -50,7 +50,12 @@ def test_cross_host_group_serves_with_parity(tmp_path, nprocs):
             "export_artifact('transformer_lm', r'%s', name='lm', version=1,"
             " config={'vocab_size': 128, 'd_model': 64, 'n_layers': 2,"
             " 'n_heads': 4, 'n_kv_heads': 2, 'd_ff': 128, 'max_seq': 64,"
-            " 'dtype': 'bfloat16'})" % str(tmp_path / "store"),
+            " 'dtype': 'bfloat16'});"
+            "export_artifact('transformer_lm', r'%s', name='draft',"
+            " version=1, seed=1, config={'vocab_size': 128, 'd_model': 32,"
+            " 'n_layers': 1, 'n_heads': 2, 'n_kv_heads': 1, 'd_ff': 64,"
+            " 'max_seq': 64, 'dtype': 'bfloat16'})"
+            % (str(tmp_path / "store"), str(tmp_path / "store")),
         ],
         check=True, env=env, cwd=REPO, timeout=120,
     )

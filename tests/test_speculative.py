@@ -228,6 +228,147 @@ def test_spec_tokens_clamped_to_power_of_two_buckets(lm_stack):
                          spec_tokens=0)
 
 
+def test_speculative_prefix_cache_conversation(tmp_path):
+    """The round-5 composition: a draft-assisted conversation through the
+    prefix cache. Turn 1 (miss) runs plain speculative but INSERTS the
+    target's post-decode rows (final-carry writeback included); turn 2 hits
+    and the target prefills only the suffix via the cached-prefix
+    speculative path. Exactness holds both turns vs plain greedy, and the
+    turn-2 hit validates the turn-1 rows token-for-token (a wrong K/V row
+    from the verify-chunk discipline would corrupt the continuation)."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+    cfg_t = dict(CFG_T, max_seq=256)
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="big", version=1,
+                    seed=0, config=cfg_t)
+    export_artifact("transformer_lm", str(store), name="tiny", version=1,
+                    seed=1, config=dict(CFG_D, max_seq=256))
+    runtime = TPUModelRuntime(ServingConfig(prefix_cache_bytes=64 << 20))
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime,
+    )
+    rt_ref = TPUModelRuntime(ServingConfig())
+    mgr_ref = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache2"), capacity_bytes=1 << 30),
+        rt_ref,
+    )
+    try:
+        big, tiny = ModelId("big", 1), ModelId("tiny", 1)
+        for m in (manager, mgr_ref):
+            m.ensure_servable(big)
+            m.ensure_servable(tiny)
+        rng = np.random.default_rng(4)
+        prompt = rng.integers(1, 128, 24).astype(np.int32).tolist()
+        pc = runtime._prefix_cache
+
+        t1 = runtime.generate(big, np.asarray([prompt], np.int32),
+                              max_new_tokens=8, temperature=0.0,
+                              draft_model_id=tiny)
+        w1 = rt_ref.generate(big, np.asarray([prompt], np.int32),
+                             max_new_tokens=8, temperature=0.0)
+        np.testing.assert_array_equal(t1, w1)
+        # the SPEC path inserted rows (24 + 8 = 32 valid -> 32 stored)
+        assert len(pc) == 1 and pc.misses >= 1
+
+        turn2 = prompt + t1[0].tolist() + rng.integers(1, 128, 4).tolist()
+        t2 = runtime.generate(big, np.asarray([turn2], np.int32),
+                              max_new_tokens=8, temperature=0.0,
+                              draft_model_id=tiny)
+        w2 = rt_ref.generate(big, np.asarray([turn2], np.int32),
+                             max_new_tokens=8, temperature=0.0)
+        assert pc.hits >= 1, (pc.hits, pc.misses)
+        np.testing.assert_array_equal(t2, w2)
+
+        # a third turn hits the rows the CACHED-PREFIX spec path stored
+        turn3 = turn2 + t2[0].tolist() + rng.integers(1, 128, 4).tolist()
+        t3 = runtime.generate(big, np.asarray([turn3], np.int32),
+                              max_new_tokens=8, temperature=0.0,
+                              draft_model_id=tiny)
+        w3 = rt_ref.generate(big, np.asarray([turn3], np.int32),
+                             max_new_tokens=8, temperature=0.0)
+        assert pc.hits >= 2
+        np.testing.assert_array_equal(t3, w3)
+    finally:
+        manager.close()
+        mgr_ref.close()
+
+
+def test_spec_prefix_rows_survive_overshoot_final_round(tmp_path):
+    """Review repro: when the FINAL verify round overshoots max_new (clamp
+    fires — guaranteed here by draft == target, acceptance 100%, spec=4,
+    max_new=8: rounds advance 1 -> 6 -> clamp), the unemitted carry must
+    NOT be written over the last completion position's K/V row. With the
+    bug, turn 2's continuation attends to the wrong row and diverges from
+    plain greedy; prompt 24 + max_new 8 = 32 = pow2 keeps the poisoned row
+    inside the stored entry."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+
+    cfg_t = dict(CFG_T, max_seq=256)
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="big", version=1,
+                    seed=0, config=cfg_t)
+    runtime = TPUModelRuntime(ServingConfig(prefix_cache_bytes=64 << 20))
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime,
+    )
+    rt_ref = TPUModelRuntime(ServingConfig())
+    mgr_ref = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache2"), capacity_bytes=1 << 30),
+        rt_ref,
+    )
+    try:
+        big = ModelId("big", 1)
+        manager.ensure_servable(big)
+        mgr_ref.ensure_servable(big)
+        rng = np.random.default_rng(8)
+        prompt = rng.integers(1, 128, 24).astype(np.int32).tolist()
+        # draft == target: every proposal accepted -> overshoot on round 2
+        t1 = runtime.generate(big, np.asarray([prompt], np.int32),
+                              max_new_tokens=8, temperature=0.0,
+                              draft_model_id=big, spec_tokens=4)
+        w1 = rt_ref.generate(big, np.asarray([prompt], np.int32),
+                             max_new_tokens=8, temperature=0.0)
+        np.testing.assert_array_equal(t1, w1)
+        assert len(runtime._prefix_cache) == 1  # 32 rows stored
+        turn2 = prompt + t1[0].tolist() + rng.integers(1, 128, 4).tolist()
+        t2 = runtime.generate(big, np.asarray([turn2], np.int32),
+                              max_new_tokens=8, temperature=0.0)
+        w2 = rt_ref.generate(big, np.asarray([turn2], np.int32),
+                             max_new_tokens=8, temperature=0.0)
+        assert runtime._prefix_cache.hits >= 1
+        np.testing.assert_array_equal(t2, w2)  # poisoned row would diverge
+    finally:
+        manager.close()
+        mgr_ref.close()
+
+
+def test_speculative_cached_kv_api_validation(models):
+    """return_cache / cached_kv are B=1 only — loud errors, not wrong rows."""
+    mt, pt, md, pd = models
+    ids2 = np.random.default_rng(6).integers(0, 128, (2, 8)).astype(np.int32)
+    with pytest.raises(ValueError, match="B=1"):
+        speculative_generate(mt, pt, md, pd, ids2, max_new_tokens=4,
+                             return_cache=True)
+    with pytest.raises(ValueError, match="B=1"):
+        speculative_generate(mt, pt, md, pd, ids2, max_new_tokens=4,
+                             cached_kv=(ids2[:1, :4], 4, None, None, 4))
+
+
 def test_spec_draft_autodisable_on_low_acceptance(lm_stack, tmp_path, caplog):
     """An adversarial draft (all-zero params: always proposes token 0) makes
     every verify round emit ~1 token — strictly more target work per token

@@ -213,15 +213,24 @@ class ObjectStoreProvider(ModelProvider):
             else:
                 from concurrent.futures import ThreadPoolExecutor, as_completed
 
-                with ThreadPoolExecutor(
+                # NOT a with-block: the context manager's __exit__ joins all
+                # in-flight downloads, which would hold the fail-fast raise
+                # (and the cold-load deadline) hostage to the slowest
+                # transfer's retries. On error the queued futures are
+                # cancelled and the raise propagates immediately; abandoned
+                # in-flight workers hit ENOENT once atomic_dest removes the
+                # staging dir and die into their unread futures (a residual
+                # .tmp-* dir from that race is reaped by the disk cache's
+                # restart recovery).
+                pool = ThreadPoolExecutor(
                     max_workers=min(_DOWNLOAD_CONCURRENCY, len(work)),
                     thread_name_prefix="tpusc-fetch",
-                ) as pool:
+                )
+                try:
                     futures = {
                         pool.submit(self._download, obj.key, local): obj
                         for obj, local in work
                     }
-                    first_err = None
                     for f in as_completed(futures):
                         try:
                             f.result()
@@ -229,17 +238,14 @@ class ObjectStoreProvider(ModelProvider):
                         except Exception as e:  # noqa: BLE001
                             # fail fast: a multi-GB artifact must not keep
                             # streaming its other objects (egress + the cold
-                            # deadline) after one of them already failed
-                            first_err = e
-                            pool.shutdown(wait=False, cancel_futures=True)
-                            break
-                    if first_err is not None:
-                        # atomic_dest discards the staging dir on raise: no
-                        # partial artifact ever lands at the final path
-                        raise ProviderError(
-                            f"object download failed (remaining downloads "
-                            f"cancelled): {first_err}"
-                        ) from first_err
+                            # deadline) after one of them already failed.
+                            # atomic_dest discards the staging dir on raise.
+                            raise ProviderError(
+                                f"object download failed (remaining "
+                                f"downloads cancelled): {e}"
+                            ) from e
+                finally:
+                    pool.shutdown(wait=False, cancel_futures=True)
         log.info("downloaded %s/%d: %d objects, %d bytes", name, version, len(objects), total)
         return Model(
             identifier=ModelId(name, version), path=dest_dir, size_on_disk=total

@@ -39,60 +39,31 @@ def _greedy(logits) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "cfg_t_key", "cfg_d_key", "max_new_tokens", "spec_tokens",
-        "family_t", "family_d",
-    ),
-)
-def _speculative_jit(
-    params_t,
-    params_d,
-    input_ids,
-    prompt_len,
-    *,
-    cfg_t_key,
-    cfg_d_key,
-    max_new_tokens: int,
-    spec_tokens: int,
-    family_t: str,
-    family_d: str,
-):
-    cfg_t = dict(cfg_t_key)
-    cfg_d = dict(cfg_d_key)
-    b, s_max = input_ids.shape
-    spec = spec_tokens
-    # slack for chunk writes past the last emitted position (stale rows are
-    # masked off and finished examples may keep writing while others drain)
-    max_len = s_max + max_new_tokens + spec + 1
-    cache_t = init_cache(cfg_t, b, max_len)
-    cache_d = init_cache(cfg_d, b, max_len)
-
-    zeros = jnp.zeros((b,), jnp.int32)
-    logits_t, cache_t = _forward_cached_dyn(
-        params_t, input_ids, cache_t, zeros, cfg_t, family_t
-    )
-    _, cache_d = _forward_cached_dyn(
-        params_d, input_ids, cache_d, zeros, cfg_d, family_d
-    )
-    last = jnp.take_along_axis(
-        logits_t, (prompt_len - 1)[:, None, None], axis=1
-    )[:, 0]
-    first = _greedy(last)
-
+def _spec_decode_loop(params_t, params_d, cache_t, cache_d, first, prompt_len,
+                      cfg_t, cfg_d, family_t, family_d, spec: int,
+                      max_new_tokens: int):
+    """The draft-propose / target-verify loop, shared by the plain and the
+    cached-prefix entries (their caches differ only in how the TARGET
+    prefill was produced; absolute positions are identical). Returns
+    (out, rounds, cache_t, final_tok, final_idx) — final_tok is the last
+    round's carry, final_idx its EMITTED index (n_done_old + a, unclamped):
+    when the final round overshoots max_new_tokens the carry was never
+    returned to the client and must NOT be written at the last completion
+    position (see _writeback_final)."""
+    b = first.shape[0]
     out = jnp.zeros((b, max_new_tokens), jnp.int32)
     out = out.at[:, 0].set(first)
     n_done = jnp.ones((b,), jnp.int32)
     rows = jnp.arange(b)[:, None]
     jrange = jnp.arange(spec + 1)
+    final_idx0 = jnp.zeros((b,), jnp.int32)  # `first` sits at emitted idx 0
 
     def cond(carry):
-        _, _, _, n_done, _, _ = carry
+        _, _, _, n_done, _, _, _ = carry
         return jnp.any(n_done < max_new_tokens)
 
     def body(carry):
-        cache_t, cache_d, cur_tok, n_done, out, rounds = carry
+        cache_t, cache_d, cur_tok, n_done, out, rounds, _ = carry
         # cur_tok is the accepted token AT position pos, not yet in either
         # cache (the same invariant as generation.py's scan step)
         pos = prompt_len + n_done - 1
@@ -138,15 +109,176 @@ def _speculative_jit(
         idx = jnp.where(valid, idx, max_new_tokens)             # OOB -> drop
         out = out.at[rows, idx].set(e, mode="drop")
 
+        carry_idx = n_done + a  # g_at_a's emitted index, unclamped
         n_done = jnp.minimum(n_done + a + 1, max_new_tokens)
-        return cache_t, cache_d, g_at_a, n_done, out, rounds + 1
+        return cache_t, cache_d, g_at_a, n_done, out, rounds + 1, carry_idx
 
-    _, _, _, _, out, rounds = jax.lax.while_loop(
-        cond, body, (cache_t, cache_d, first, n_done, out, jnp.int32(0))
+    cache_t, _, final_tok, _, out, rounds, final_idx = jax.lax.while_loop(
+        cond, body,
+        (cache_t, cache_d, first, n_done, out, jnp.int32(0), final_idx0),
     )
     # rounds is a cheap health signal: a well-aligned draft should emit
     # ~spec+1 tokens per round; tests use it to catch acceptance decay that
     # exactness alone can't see (output stays correct regardless)
+    return out, rounds, cache_t, final_tok, final_idx
+
+
+def _writeback_final(params_t, cache_t, final_tok, final_idx, prompt_len,
+                     cfg_t, family_t, max_new_tokens: int):
+    """One (B, 1) target forward so the LAST completion position's K/V row
+    is valid: rows are then correct for the whole prompt+completion. Every
+    other emitted token was the input of some later verify chunk, so its
+    row is already written; rejected tokens' rows were overwritten by the
+    chunk that followed their rejection (the cache discipline in the module
+    docstring).
+
+    Overshoot case (final round clamped: final_idx > max_new-1): the carry
+    was NEVER emitted, while the true last token out[:, max_new-1] was an
+    ACCEPTED draft input of that chunk — its row is already correct.
+    Writing the carry at prompt_len+max_new-1 would stomp it with a
+    different token's K/V and poison the stored prefix entry, so the
+    forward is aimed one slot PAST the persisted range instead (the slack
+    rows every spec cache allocates; the row is junk nobody reads)."""
+    overshoot = (final_idx > max_new_tokens - 1).astype(jnp.int32)
+    pos = prompt_len + max_new_tokens - 1 + overshoot
+    _, cache_t = _forward_cached_dyn(
+        params_t, final_tok[:, None], cache_t, pos, cfg_t, family_t,
+    )
+    return cache_t
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg_t_key", "cfg_d_key", "max_new_tokens", "spec_tokens",
+        "family_t", "family_d", "return_cache",
+    ),
+)
+def _speculative_jit(
+    params_t,
+    params_d,
+    input_ids,
+    prompt_len,
+    *,
+    cfg_t_key,
+    cfg_d_key,
+    max_new_tokens: int,
+    spec_tokens: int,
+    family_t: str,
+    family_d: str,
+    return_cache: bool = False,
+):
+    cfg_t = dict(cfg_t_key)
+    cfg_d = dict(cfg_d_key)
+    b, s_max = input_ids.shape
+    spec = spec_tokens
+    # slack for chunk writes past the last emitted position (stale rows are
+    # masked off and finished examples may keep writing while others drain)
+    max_len = s_max + max_new_tokens + spec + 1
+    cache_t = init_cache(cfg_t, b, max_len)
+    cache_d = init_cache(cfg_d, b, max_len)
+
+    zeros = jnp.zeros((b,), jnp.int32)
+    logits_t, cache_t = _forward_cached_dyn(
+        params_t, input_ids, cache_t, zeros, cfg_t, family_t
+    )
+    _, cache_d = _forward_cached_dyn(
+        params_d, input_ids, cache_d, zeros, cfg_d, family_d
+    )
+    last = jnp.take_along_axis(
+        logits_t, (prompt_len - 1)[:, None, None], axis=1
+    )[:, 0]
+    first = _greedy(last)
+
+    out, rounds, cache_t, final_tok, final_idx = _spec_decode_loop(
+        params_t, params_d, cache_t, cache_d, first, prompt_len,
+        cfg_t, cfg_d, family_t, family_d, spec, max_new_tokens,
+    )
+    if return_cache:
+        cache_t = _writeback_final(
+            params_t, cache_t, final_tok, final_idx, prompt_len, cfg_t,
+            family_t, max_new_tokens,
+        )
+        return out, rounds, cache_t["k"], cache_t["v"]
+    return out, rounds
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg_t_key", "cfg_d_key", "max_new_tokens", "spec_tokens",
+        "family_t", "family_d", "return_cache",
+    ),
+)
+def _speculative_from_cache_jit(
+    params_t,
+    params_d,
+    input_ids,          # (1, S_pad) FULL prompt — the draft prefills it all
+    prompt_len,         # (1,)
+    suffix_ids,         # (1, S_suffix_pad) prompt tokens AFTER the prefix
+    suffix_len,         # (1,)
+    cached_k,           # (layers, 1, n_kv, Lpad, head_dim) TARGET prefix K/V
+    cached_v,
+    cached_len,         # (1,) valid prefix rows; cached_len+suffix_len==prompt_len
+    *,
+    cfg_t_key,
+    cfg_d_key,
+    max_new_tokens: int,
+    spec_tokens: int,
+    family_t: str,
+    family_d: str,
+    return_cache: bool = True,
+):
+    """Speculative decoding whose TARGET prefill starts from cached prompt-
+    prefix K/V (runtime/prefix_cache.py): turn N of a draft-assisted
+    conversation pays target prefill only for its new tokens. The draft has
+    no cached rows — it prefills the full prompt, which costs a fraction of
+    the target prefill it replaces. Absolute positions are identical to the
+    plain path, so the verify loop is shared and the output is the same
+    greedy sequence."""
+    cfg_t = dict(cfg_t_key)
+    cfg_d = dict(cfg_d_key)
+    b, s_max = input_ids.shape
+    spec = spec_tokens
+    _, s_pad = suffix_ids.shape
+    l_pad = cached_k.shape[3]
+
+    # target: copy prefix rows, prefill only the suffix
+    cache_t = init_cache(cfg_t, b, l_pad + s_pad + max_new_tokens + spec + 1)
+    cache_t = {
+        "k": jax.lax.dynamic_update_slice(
+            cache_t["k"], cached_k.astype(cache_t["k"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache_t["v"], cached_v.astype(cache_t["v"].dtype), (0, 0, 0, 0, 0)
+        ),
+    }
+    start = cached_len.astype(jnp.int32)
+    logits_t, cache_t = _forward_cached_dyn(
+        params_t, suffix_ids, cache_t, start, cfg_t, family_t
+    )
+    last = jnp.take_along_axis(
+        logits_t, (suffix_len - 1)[:, None, None], axis=1
+    )[:, 0]
+    first = _greedy(last)
+
+    # draft: full prefill (no draft rows are cached)
+    cache_d = init_cache(cfg_d, b, s_max + max_new_tokens + spec + 1)
+    _, cache_d = _forward_cached_dyn(
+        params_d, input_ids, cache_d, jnp.zeros((b,), jnp.int32), cfg_d,
+        family_d,
+    )
+
+    out, rounds, cache_t, final_tok, final_idx = _spec_decode_loop(
+        params_t, params_d, cache_t, cache_d, first, prompt_len,
+        cfg_t, cfg_d, family_t, family_d, spec, max_new_tokens,
+    )
+    if return_cache:
+        cache_t = _writeback_final(
+            params_t, cache_t, final_tok, final_idx, prompt_len, cfg_t,
+            family_t, max_new_tokens,
+        )
+        return out, rounds, cache_t["k"], cache_t["v"]
     return out, rounds
 
 
@@ -160,6 +292,8 @@ def speculative_generate(
     max_new_tokens: int = 32,
     spec_tokens: int = 4,
     return_rounds: bool = False,
+    return_cache: bool = False,
+    cached_kv: tuple | None = None,
 ) -> jax.Array:
     """Greedy decode of the TARGET model, accelerated by the draft.
 
@@ -171,6 +305,14 @@ def speculative_generate(
     argmax can break the other way (same caveat as any shape-dependent
     float reduction). ``return_rounds=True`` also returns the verify-round
     count — the acceptance-health signal tests use.
+
+    ``return_cache=True`` (B=1) also returns the TARGET's post-decode K/V
+    (rows valid for the whole prompt+completion — a final writeback forward
+    covers the last carry), so the runtime can prime the prefix cache.
+    ``cached_kv=(suffix_ids, suffix_len, k, v, cached_len)`` starts the
+    target prefill from cached prefix rows instead of the full prompt (the
+    draft still prefills the full ``input_ids``); the emitted sequence is
+    the same greedy decode either way.
     """
     for md, role in ((model_def_t, "target"), (model_def_d, "draft")):
         if md.family not in ("transformer_lm", "moe_lm"):
@@ -198,16 +340,34 @@ def speculative_generate(
             f"{model_def_t.config['max_seq']}"
         )
     key = lambda cfg: tuple(sorted((k, v) for k, v in cfg.items()))
-    out, rounds = _speculative_jit(
-        params_t,
-        params_d,
-        input_ids,
-        prompt_lengths,
+    common = dict(
         cfg_t_key=key(model_def_t.config),
         cfg_d_key=key(model_def_d.config),
         max_new_tokens=max_new_tokens,
         spec_tokens=spec_tokens,
         family_t=model_def_t.family,
         family_d=model_def_d.family,
+        return_cache=return_cache,
     )
+    if cached_kv is not None:
+        if b != 1:
+            raise ValueError("cached-prefix speculative decoding is B=1 only")
+        suffix_ids, suffix_len, ck, cv, cached_len = cached_kv
+        res = _speculative_from_cache_jit(
+            params_t, params_d, input_ids, prompt_lengths,
+            jnp.asarray(suffix_ids, jnp.int32),
+            jnp.asarray(suffix_len, jnp.int32).reshape(1),
+            ck, cv, jnp.asarray(cached_len, jnp.int32).reshape(1),
+            **common,
+        )
+    else:
+        if return_cache and b != 1:
+            raise ValueError("return_cache speculative decoding is B=1 only")
+        res = _speculative_jit(
+            params_t, params_d, input_ids, prompt_lengths, **common
+        )
+    if return_cache:
+        out, rounds, k, v = res
+        return (out, rounds, k, v) if return_rounds else (out, k, v)
+    out, rounds = res
     return (out, rounds) if return_rounds else out

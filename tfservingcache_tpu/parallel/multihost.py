@@ -616,13 +616,14 @@ class MultiHostGroupRuntime(TPUModelRuntime):
             # follower must never "decide locally" — with mixed
             # prefix_cache_bytes configs that silently enters a different
             # program than the leader's (miss-path gen carries
-            # return_cache; plain gen does not).
+            # return_cache; plain gen does not). Draft requests use the
+            # SAME decision: the speculative path is prefix-aware (the
+            # target prefills from the cached rows).
             decision["rows"] = -1
             if (
                 self._prefix_cache is not None
                 and ids.ndim == 2
                 and ids.shape[0] == 1
-                and not use
                 # malformed prompt_lengths must reach generate's own
                 # validation (clean 400), not crash the peek with IndexError
                 and lengths.shape == (1,)

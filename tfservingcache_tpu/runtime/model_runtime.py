@@ -653,47 +653,38 @@ class TPUModelRuntime(BaseRuntime):
                 # pair re-auditions
                 TRACER.annotate(spec_gated=True)
                 draft = None
+            prefix_capable = (
+                self._prefix_cache is not None and ids.shape[0] == 1
+            )
+            if prefix_rows is not None:
+                if prefix_rows < 0:
+                    # the leader runs the cache-LESS plain path (no
+                    # return_cache, no insert): this process must run
+                    # the identical program even if it has a cache
+                    prefix_capable = False
+                elif not prefix_capable:
+                    # a forced prefix-machinery decision (miss included:
+                    # its gen runs with return_cache, a different
+                    # program than plain) this process cannot attempt
+                    # must fail LOUDLY before any device op
+                    raise RuntimeError_(
+                        f"prefix-cache divergence for {model_id}: leader "
+                        f"decided {prefix_rows} cached rows but this "
+                        "process cannot run the prefix path "
+                        "(prefix_cache_bytes mismatch across the group?)"
+                    )
             if draft is not None:
-                from tfservingcache_tpu.models.speculative import (
-                    speculative_generate,
-                )
-
-                toks, rounds = speculative_generate(
-                    loaded.model_def,
-                    loaded.params,
-                    draft.model_def,
-                    draft.params,
-                    ids,
-                    prompt_lengths=lengths,
-                    max_new_tokens=new_bucket,
-                    spec_tokens=spec_tokens,
-                    return_rounds=True,
+                toks, rounds = self._speculative(
+                    loaded, draft, model_id, ids, lengths, new_bucket,
+                    max_new_tokens, spec_tokens,
+                    forced_rows=prefix_rows if prefix_capable else None,
+                    prefix_capable=prefix_capable,
                 )
                 self._spec_observe(
-                    model_id, draft_model_id, new_bucket, int(rounds)
+                    model_id, draft_model_id, new_bucket, rounds
                 )
             else:
                 toks = None
-                prefix_capable = (
-                    self._prefix_cache is not None and ids.shape[0] == 1
-                )
-                if prefix_rows is not None:
-                    if prefix_rows < 0:
-                        # the leader runs the cache-LESS plain path (no
-                        # return_cache, no insert): this process must run
-                        # the identical program even if it has a cache
-                        prefix_capable = False
-                    elif not prefix_capable:
-                        # a forced prefix-machinery decision (miss included:
-                        # its gen runs with return_cache, a different
-                        # program than plain) this process cannot attempt
-                        # must fail LOUDLY before any device op
-                        raise RuntimeError_(
-                            f"prefix-cache divergence for {model_id}: leader "
-                            f"decided {prefix_rows} cached rows but this "
-                            "process cannot run the prefix path "
-                            "(prefix_cache_bytes mismatch across the group?)"
-                        )
                 if prefix_capable:
                     toks = self._prefix_generate(
                         loaded, model_id, ids, int(lengths[0]), new_bucket,
@@ -857,14 +848,37 @@ class TPUModelRuntime(BaseRuntime):
             generate_from_cache,
         )
 
-        pc = self._prefix_cache
         prompt = ids[0, :prompt_len]
         rng = jax.random.PRNGKey(seed)
-        if forced_rows == 0:
-            hit = None
-            pc.note_forced_miss()
+        hit = self._prefix_resolve(model_id, prompt, forced_rows)
+        if hit is None:
+            toks_d, k_full, v_full = gen(
+                loaded.model_def, loaded.params, ids,
+                prompt_lengths=np.array([prompt_len], np.int32),
+                max_new_tokens=new_bucket, temperature=temperature,
+                top_k=top_k, rng=rng, return_cache=True,
+            )
         else:
-            hit = pc.lookup(model_id, prompt)
+            suffix, suffix_len = self._prefix_suffix(ids, prompt_len, hit)
+            toks_d, k_full, v_full = generate_from_cache(
+                loaded.model_def, loaded.params, suffix, suffix_len,
+                hit.k, hit.v, hit.valid_len, max_new_tokens=new_bucket,
+                temperature=temperature, top_k=top_k, rng=rng,
+                return_cache=True,
+            )
+        return self._prefix_store(
+            model_id, prompt, prompt_len, max_new, toks_d, k_full, v_full, hit
+        )
+
+    def _prefix_resolve(self, model_id, prompt, forced_rows: int | None):
+        """Hit decision for the prefix paths (plain + speculative): local
+        lookup, or the group leader's forced decision — which this process
+        must honor exactly or fail loudly before any device op."""
+        pc = self._prefix_cache
+        if forced_rows == 0:
+            pc.note_forced_miss()
+            return None
+        hit = pc.lookup(model_id, prompt)
         if forced_rows is not None and forced_rows > 0:
             if hit is None or hit.valid_len < forced_rows:
                 raise RuntimeError_(
@@ -878,39 +892,38 @@ class TPUModelRuntime(BaseRuntime):
 
                 hit = PrefixEntry(hit.tokens[:forced_rows], hit.k, hit.v,
                                   forced_rows, hit.nbytes)
-        if hit is None:
-            toks_d, k_full, v_full = gen(
-                loaded.model_def, loaded.params, ids,
-                prompt_lengths=np.array([prompt_len], np.int32),
-                max_new_tokens=new_bucket, temperature=temperature,
-                top_k=top_k, rng=rng, return_cache=True,
-            )
-        else:
-            l_use = hit.valid_len
-            suffix = ids[:1, l_use:prompt_len]
-            suffix_len = prompt_len - l_use
-            s_pad = next_bucket(suffix_len)
-            if s_pad != suffix.shape[1]:
-                suffix = np.pad(suffix, ((0, 0), (0, s_pad - suffix.shape[1])))
-            toks_d, k_full, v_full = generate_from_cache(
-                loaded.model_def, loaded.params, suffix, suffix_len,
-                hit.k, hit.v, l_use, max_new_tokens=new_bucket,
-                temperature=temperature, top_k=top_k, rng=rng,
-                return_cache=True,
-            )
+        return hit
+
+    @staticmethod
+    def _prefix_suffix(ids, prompt_len: int, hit):
+        """(padded suffix ids, true suffix length) after ``hit``'s rows."""
+        l_use = hit.valid_len
+        suffix = ids[:1, l_use:prompt_len]
+        suffix_len = prompt_len - l_use
+        s_pad = next_bucket(suffix_len)
+        if s_pad != suffix.shape[1]:
+            suffix = np.pad(suffix, ((0, 0), (0, s_pad - suffix.shape[1])))
+        return suffix, suffix_len
+
+    def _prefix_store(self, model_id, prompt, prompt_len: int, max_new: int,
+                      toks_d, k_full, v_full, hit):
+        """Read back tokens, insert the (prompt + completion) rows for the
+        next turn, record stats. Rows are valid through prompt_len +
+        new_bucket (plain: the scan forwards the carry before sampling;
+        speculative: the final-carry writeback) — but the entry must stop at
+        the TRUE max_new: the bucket-padding generations were never returned
+        to the client, so the next turn's prompt diverges exactly there and
+        an entry containing them would never match again (review repro:
+        max_new=5 bucketed to 8 made every conversation a permanent miss)."""
+        import jax
+
+        pc = self._prefix_cache
         if self._mp_mesh:
             # sharded result: force replication so THIS process can read the
             # tokens (same jitted identity the plain path uses); K/V stay
             # sharded — each process caches its own shards
             toks_d = self._replicated(toks_d)
         toks = np.asarray(jax.device_get(toks_d))
-        # every emitted token's K/V row was written (the scan forwards the
-        # carry token before sampling the next), so rows are valid through
-        # prompt_len + new_bucket — but the entry must stop at the TRUE
-        # max_new: the bucket-padding generations were never returned to the
-        # client, so the next turn's prompt diverges exactly there and an
-        # entry containing them would never match again (review repro:
-        # max_new=5 bucketed to 8 made every conversation a permanent miss)
         valid = prompt_len + max_new
         entry_tokens = np.concatenate([prompt, toks[0, :max_new]])
         # store at the power-of-two FLOOR of the valid rows: only pow2 row
@@ -932,6 +945,46 @@ class TPUModelRuntime(BaseRuntime):
              else self.metrics.prefix_cache_misses).inc()
             self.metrics.prefix_cache_bytes.set(pc.total_bytes)
         return toks
+
+    def _speculative(self, loaded, draft, model_id, ids, lengths, new_bucket,
+                     max_new: int, spec_tokens: int,
+                     forced_rows: int | None, prefix_capable: bool):
+        """Speculative decoding, prefix-cache aware (VERDICT r5 composition):
+        when the cache is on and B=1, the TARGET's prefill starts from the
+        cached prompt-prefix rows and the completion is inserted back — a
+        draft-assisted conversation pays target prefill only for its new
+        tokens from turn 2. Returns (tokens — host array on the prefix
+        path, device array otherwise — and the verify-round count)."""
+        from tfservingcache_tpu.models.speculative import speculative_generate
+
+        if not prefix_capable:
+            # device tokens returned as-is: generate()'s shared tail handles
+            # the group replication + device_get exactly once
+            toks, rounds = speculative_generate(
+                loaded.model_def, loaded.params, draft.model_def,
+                draft.params, ids, prompt_lengths=lengths,
+                max_new_tokens=new_bucket, spec_tokens=spec_tokens,
+                return_rounds=True,
+            )
+            return toks, int(rounds)
+
+        prompt_len = int(lengths[0])
+        prompt = ids[0, :prompt_len]
+        hit = self._prefix_resolve(model_id, prompt, forced_rows)
+        cached_kv = None
+        if hit is not None:
+            suffix, suffix_len = self._prefix_suffix(ids, prompt_len, hit)
+            cached_kv = (suffix, suffix_len, hit.k, hit.v, hit.valid_len)
+        toks_d, rounds, k_full, v_full = speculative_generate(
+            loaded.model_def, loaded.params, draft.model_def, draft.params,
+            ids, prompt_lengths=np.array([prompt_len], np.int32),
+            max_new_tokens=new_bucket, spec_tokens=spec_tokens,
+            return_rounds=True, return_cache=True, cached_kv=cached_kv,
+        )
+        toks = self._prefix_store(
+            model_id, prompt, prompt_len, max_new, toks_d, k_full, v_full, hit
+        )
+        return toks, int(rounds)
 
     def resident_headroom(self) -> tuple[int | None, int]:
         """(free resident model slots or None if uncapped, free HBM bytes).
