@@ -216,6 +216,54 @@ def test_moe_family_prefix_and_speculative_exactness(tmp_path):
         mgr_plain.close()
 
 
+def test_concurrent_conversations_race_free(stacks):
+    """The serving reality: several B=1 conversations interleave on one
+    model. Each thread's turns must stay token-exact vs its own plain-path
+    replay regardless of what the other threads' lookups/inserts/evictions
+    do to the shared cache (PrefixCache locks internally; a race would show
+    up as a wrong continuation, not just a crash)."""
+    import threading
+
+    manager, runtime = stacks(64 << 20)
+    mgr_ref, rt_ref = stacks(0)  # plain-path reference (cache off)
+    mid = ModelId("m", 1)
+    errs = []
+
+    def conversation(tid: int) -> None:
+        try:
+            r = np.random.default_rng(1000 + tid)
+            prompt = r.integers(0, 128, 20 + tid).astype(np.int32).tolist()
+            for _turn in range(3):
+                got = runtime.generate(
+                    mid, np.asarray([prompt], np.int32), max_new_tokens=8,
+                    seed=tid,
+                )
+                want = rt_ref.generate(
+                    mid, np.asarray([prompt], np.int32), max_new_tokens=8,
+                    seed=tid,
+                )
+                np.testing.assert_array_equal(got, want)
+                prompt = prompt + got[0].tolist() + r.integers(
+                    0, 128, 3
+                ).astype(np.int32).tolist()
+        except Exception as e:  # noqa: BLE001
+            errs.append((tid, e))
+
+    threads = [
+        threading.Thread(target=conversation, args=(t,)) for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    # a timed-out (deadlocked) thread must FAIL here, not quietly pass the
+    # counter assertion below and wedge teardown
+    assert not any(t.is_alive() for t in threads), "thread deadlocked"
+    assert not errs, errs
+    pc = runtime._prefix_cache
+    assert pc.hits + pc.misses >= 12  # every turn consulted the cache
+
+
 def test_prefix_entries_dropped_on_unload(stacks):
     _, rt = stacks(64 << 20)
     mid = ModelId("m", 1)
