@@ -25,6 +25,44 @@ def runtime():
     rt.close()
 
 
+def test_cli_warm_populates_compile_cache(tmp_path, monkeypatch):
+    """`tpuserve warm <artifact>` compiles the serving programs through the
+    real runtime and persists them in serving.compile_cache_dir — the deploy
+    image bake step that turns a node's first cold load into a compile-cache
+    hit (SURVEY §7 hard part (a))."""
+    import os
+
+    from tfservingcache_tpu.cli import main as cli_main
+    from tfservingcache_tpu.models.registry import export_artifact
+
+    import jax
+
+    art = export_artifact("transformer_lm", str(tmp_path / "store"),
+                          name="lm", version=1, config={
+                              "vocab_size": 64, "d_model": 32, "n_layers": 1,
+                              "n_heads": 2, "n_kv_heads": 1, "d_ff": 64,
+                              "max_seq": 64, "dtype": "float32"})
+    cache_dir = tmp_path / "xla-cache"
+    monkeypatch.setenv("TPUSC_SERVING_COMPILE_CACHE_DIR", str(cache_dir))
+    monkeypatch.setenv("TPUSC_SERVING_PLATFORM", "cpu")
+    prior_cache_dir = jax.config.jax_compilation_cache_dir
+    try:
+        # defaults (128/32) exceed max_seq 64: warm must CLAMP, not crash
+        assert cli_main(["warm", art, "--batches", "1,2"]) == 0
+        # the persistent cache holds compiled programs for serve to re-hit
+        entries = [
+            f for f in os.listdir(cache_dir) if not f.startswith(".")
+        ] if cache_dir.exists() else []
+        assert entries, "compile cache dir is empty after warm"
+        # no cache dir configured -> explicit error, not a silent no-op warm
+        monkeypatch.setenv("TPUSC_SERVING_COMPILE_CACHE_DIR", "")
+        assert cli_main(["warm", art]) == 2
+    finally:
+        # the runtime flips the PROCESS-GLOBAL jax compilation cache dir;
+        # later tests' cold-compile behavior must not depend on this tmp dir
+        jax.config.update("jax_compilation_cache_dir", prior_cache_dir)
+
+
 def test_next_bucket():
     assert [next_bucket(n) for n in (0, 1, 2, 3, 4, 5, 8, 9, 100)] == [
         1, 1, 2, 4, 4, 8, 8, 16, 128,

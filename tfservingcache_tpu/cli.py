@@ -37,6 +37,26 @@ def main(argv: list[str] | None = None) -> int:
     )
     rep.add_argument("src", help="existing artifact dir (<...>/<name>/<version>)")
     rep.add_argument("dest", help="output artifact dir")
+    wrm = sub.add_parser(
+        "warm",
+        help="pre-populate the persistent XLA compile cache "
+        "(serving.compile_cache_dir) with an artifact's serving programs — "
+        "bake into the deploy image so a node's FIRST cold load is a "
+        "compile-cache hit (SURVEY §7: load-bearing for the <=2s target)",
+    )
+    wrm.add_argument("artifact", help="artifact dir (<...>/<name>/<version>)")
+    wrm.add_argument(
+        "--batches", default="1,2,4,8",
+        help="comma-separated predict batch buckets to compile",
+    )
+    wrm.add_argument(
+        "--lm-seq", type=int, default=128,
+        help="prompt length for LM-family predict/generate programs",
+    )
+    wrm.add_argument(
+        "--generate-tokens", type=int, default=32,
+        help="decode program length for LM families (0 skips generate)",
+    )
     args = parser.parse_args(argv)
 
     cfg = load_config(args.config)
@@ -79,7 +99,84 @@ def main(argv: list[str] | None = None) -> int:
         model, params = load_artifact(args.src, raw_quant=True)
         print(save_artifact(args.dest, model, params, quantize=src_quant))
         return 0
+    if args.cmd == "warm":
+        return _warm(cfg, args)
     return 2
+
+
+def _warm(cfg, args) -> int:
+    """Compile an artifact's serving programs through the REAL runtime (the
+    persisted cache keys must match what `serve` will look up) and leave
+    them in the persistent XLA compile cache."""
+    import os
+    import time
+
+    import numpy as np
+
+    from tfservingcache_tpu.cache.disk_cache import dir_size_bytes
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+    from tfservingcache_tpu.types import Model, ModelId
+
+    if not cfg.serving.compile_cache_dir:
+        log.error(
+            "serving.compile_cache_dir is not set: there is no persistent "
+            "cache to warm (set it in config.yaml or TPUSC_SERVING_"
+            "COMPILE_CACHE_DIR)"
+        )
+        return 2
+    art = os.path.abspath(args.artifact)
+    version_s = os.path.basename(art)
+    name = os.path.basename(os.path.dirname(art))
+    mid = ModelId(name or "model", int(version_s) if version_s.isdigit() else 1)
+    rt = TPUModelRuntime(cfg.serving)
+    compiled = []
+    t0 = time.perf_counter()
+    try:
+        rt.ensure_loaded(Model(identifier=mid, path=art,
+                               size_on_disk=dir_size_bytes(art)))
+        in_spec, _, _ = rt.signature(mid)
+        family = rt.family_of(mid)
+        loaded = rt._resident.get(mid, touch=False)
+        max_seq = int(loaded.model_def.config.get("max_seq", 0) or 0)
+        seq = args.lm_seq
+        gen_tokens = args.generate_tokens
+        if max_seq:
+            # clamp to what the model can serve: a default 128/32 against a
+            # small max_seq must warm the usable shapes, not crash mid-warm
+            seq = min(seq, max(1, max_seq // 2))
+            gen_tokens = min(gen_tokens, max_seq - seq)
+            if (seq, gen_tokens) != (args.lm_seq, args.generate_tokens):
+                log.info("clamped to seq=%d, generate_tokens=%d (max_seq %d)",
+                         seq, gen_tokens, max_seq)
+        for b in sorted({int(x) for x in args.batches.split(",") if x.strip()}):
+            inputs = {}
+            for nm, spec in in_spec.items():
+                # the FIRST dynamic dim of each input is the batch axis,
+                # later dynamic dims (LM/bert seq, t5 src/tgt) get --lm-seq
+                # — unlike the runtime's load-time _concrete_shape (all
+                # dims=1), warm must compile the shapes traffic asks for
+                shape, dyn = [], 0
+                for d in spec.norm_shape():
+                    if isinstance(d, str):
+                        shape.append(b if dyn == 0 else seq)
+                        dyn += 1
+                    else:
+                        shape.append(d)
+                inputs[nm] = np.zeros(tuple(shape), spec.np_dtype())
+            rt.predict(mid, inputs)
+            compiled.append(f"predict b={b}")
+        if family in ("transformer_lm", "moe_lm") and gen_tokens > 0:
+            ids = np.zeros((1, seq), np.int32)
+            rt.generate(mid, ids, max_new_tokens=gen_tokens)
+            compiled.append(f"generate b=1 new={gen_tokens}")
+    finally:
+        rt.close()
+    dt = time.perf_counter() - t0
+    print(
+        f"warmed {mid} ({family}): {', '.join(compiled)} in {dt:.1f}s -> "
+        f"{cfg.serving.compile_cache_dir}"
+    )
+    return 0
 
 
 if __name__ == "__main__":
