@@ -233,7 +233,7 @@ def _section(name: str):
 SECTION_GROUPS = (
     "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
-    "prefix_gen", "tenant_soak",
+    "prefix_gen", "zoo_cold", "tenant_soak",
 )
 
 
@@ -275,6 +275,9 @@ def _warm_buckets(runtime, mid, inputs, max_batch: int = 64) -> None:
 
 def _example_inputs(family: str, batch: int, config: dict | None = None,
                     seed: int = 0, lm_seq: int = 128):
+    """Spec-driven example inputs: the FIRST dynamic axis of each input is
+    the batch, later dynamic axes (seq, src/tgt) get ``lm_seq`` —
+    consistently across inputs (bert's mask must share input_ids' seq)."""
     import numpy as np
 
     from tfservingcache_tpu.models.registry import build
@@ -282,15 +285,21 @@ def _example_inputs(family: str, batch: int, config: dict | None = None,
     model_def = build(family, config)
     rng = np.random.default_rng(seed)
     out = {}
+    vocab = int(model_def.config.get("vocab_size", 8) or 8) if isinstance(
+        model_def.config, dict
+    ) else 8
     for name, spec in model_def.input_spec.items():
-        shape = tuple(batch if isinstance(d, str) else d for d in spec.norm_shape())
-        if family == "transformer_lm":
-            shape = (batch, lm_seq)
-            out[name] = rng.integers(
-                0, model_def.config["vocab_size"], shape
-            ).astype(spec.np_dtype())
-        elif spec.np_dtype().kind in "iu":
-            out[name] = rng.integers(0, 8, shape).astype(spec.np_dtype())
+        shape, dyn = [], 0
+        for d in spec.norm_shape():
+            if isinstance(d, str):
+                shape.append(batch if dyn == 0 else lm_seq)
+                dyn += 1
+            else:
+                shape.append(d)
+        shape = tuple(shape)
+        if spec.np_dtype().kind in "iu":
+            hi = vocab if "ids" in name else 2
+            out[name] = rng.integers(0, hi, shape).astype(spec.np_dtype())
         else:
             out[name] = rng.normal(size=shape).astype(spec.np_dtype())
     return out
@@ -780,6 +789,59 @@ def bench_flash_kernel() -> dict:
     return results
 
 
+def bench_zoo_cold(tmp: str) -> dict:
+    """Per-family cold p50 across the WHOLE model zoo (completeness row: a
+    reference user's arbitrary SavedModel family must cold-serve, not just
+    the two headline families). Two tenants per family: tenant0's first
+    load carries the family compile, tenant1's isolates the per-tenant cost
+    (params transfer + pin) — the number the 1000-tenant story rides on."""
+    from tfservingcache_tpu.models.registry import families
+    from tfservingcache_tpu.types import ModelId
+
+    out = {}
+    for family in sorted(families()):
+        config = None
+        if family == "bert":
+            from tfservingcache_tpu.models.bert import TINY_CONFIG as config
+        elif family == "resnet":
+            from tfservingcache_tpu.models.resnet import TINY_CONFIG as config
+        elif family == "t5":
+            from tfservingcache_tpu.models.t5 import TINY_CONFIG as config
+        elif family in ("transformer_lm", "moe_lm"):
+            config = {
+                "vocab_size": 512, "d_model": 128, "n_layers": 2,
+                "n_heads": 4, "n_kv_heads": 2, "d_ff": 256, "max_seq": 128,
+                "dtype": "bfloat16",
+                **({"n_experts": 4, "capacity_factor": 2.0,
+                    "aux_loss_weight": 0.01} if family == "moe_lm" else {}),
+            }
+        manager = None
+        try:
+            manager, runtime = _make_stack(
+                family, 2, os.path.join(tmp, f"zoo-{family}"), config=config
+            )
+            inputs = _example_inputs(family, 1, config, lm_seq=16)
+            times = []
+            for i in range(2):
+                mid = ModelId(f"tenant{i}", 1)
+                t0 = time.perf_counter()
+                manager.ensure_servable(mid)
+                runtime.predict(mid, inputs)
+                times.append(time.perf_counter() - t0)
+            out[family] = {
+                "cold_first_s": round(times[0], 3),   # family compile + load
+                "cold_sibling_s": round(times[1], 4),  # per-tenant cost
+            }
+        except Exception as e:  # noqa: BLE001 - one family must not sink the row
+            out[family] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            # a failed family must not leave its params pinned under the
+            # NEXT family's stack on the one chip
+            if manager is not None:
+                manager.close()
+    return out
+
+
 def bench_tenant_soak(tmp: str, tenants: int = 1000, requests: int = 3000) -> dict:
     """The BASELINE.md north-star scenario at FULL scale: 1000 per-tenant
     models under a 16-slot HBM cap (VERDICT r5 #3 — round 4 ran 200). The
@@ -1085,7 +1147,7 @@ def collect_watcher_evidence() -> dict:
     keep_sections = (
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
-        "device_kind", "chips", "only",
+        "zoo_cold", "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
         if not fn.endswith(".json") or fn.endswith(".partial.json"):
@@ -1338,6 +1400,13 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["prefix_gen"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("zoo_cold"):
+        try:
+            with _section("zoo_cold"):
+                detail["zoo_cold"] = bench_zoo_cold(tmp)
+        except Exception as e:  # noqa: BLE001
+            detail["zoo_cold"] = {"error": f"{type(e).__name__}: {e}"}
 
     if want("tenant_soak"):
         try:
