@@ -314,7 +314,7 @@ def _input_variants(family: str, batch: int, config: dict | None,
 
 _COLD_STAGES = (
     "provider_fetch", "artifact_read", "device_transfer", "device_dequant",
-    "compile_warmup", "transfer_sync",
+    "host_dequant", "compile_warmup", "transfer_sync",
 )
 
 

@@ -25,6 +25,40 @@ def runtime():
     rt.close()
 
 
+def test_cold_stage_histograms_recorded(tmp_path):
+    """Every cold load feeds tpusc_cold_stage_seconds{stage} — operators
+    answer 'where do my cold seconds go' (and the int8 crossover) from
+    /metrics instead of re-running under a profiler."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.models.registry import export_artifact
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    export_artifact("half_plus_two", str(tmp_path / "store"), name="m",
+                    version=1)
+    metrics = Metrics()
+    rt = TPUModelRuntime(ServingConfig(), metrics=metrics)
+    mgr = CacheManager(
+        DiskModelProvider(str(tmp_path / "store")),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        rt, metrics,
+    )
+    try:
+        mgr.ensure_servable(ModelId("m", 1))
+        text = metrics.render().decode()
+        for stage in ("provider_fetch", "artifact_read", "device_transfer",
+                      "compile_warmup"):
+            line = next(
+                (ln for ln in text.splitlines()
+                 if ln.startswith("tpusc_cold_stage_seconds_count")
+                 and f'stage="{stage}"' in ln), None,
+            )
+            assert line is not None and float(line.split()[-1]) >= 1.0, stage
+    finally:
+        mgr.close()
+
+
 def test_cli_warm_populates_compile_cache(tmp_path, monkeypatch):
     """`tpuserve warm <artifact>` compiles the serving programs through the
     real runtime and persists them in serving.compile_cache_dir — the deploy

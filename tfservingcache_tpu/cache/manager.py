@@ -213,6 +213,11 @@ class CacheManager:
             self.metrics.cache_fetch_duration.labels(
                 self.metrics.model_label(model_id.name, model_id.version)
             ).observe(time.monotonic() - t0)
+            # the fetch stage of the cold-stage histogram family (its device
+            # siblings are recorded by the runtime's load span)
+            self.metrics.cold_stage_seconds.labels("provider_fetch").observe(
+                time.monotonic() - t0
+            )
         log.info(
             "fetched %s (%d bytes) in %.2fs", model_id, model.size_on_disk, time.monotonic() - t0
         )

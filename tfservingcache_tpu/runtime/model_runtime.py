@@ -280,8 +280,16 @@ class TPUModelRuntime(BaseRuntime):
         mid = model.identifier
         self._set_state(mid, ModelState.START)
         t0 = time.monotonic()
-        with TRACER.span("load", model=str(mid)):
+        with TRACER.span("load", model=str(mid)) as load_span:
             self._load_traced(model, mid, t0)
+        if self.metrics is not None:
+            # per-stage cold histograms: the in-production "where do my cold
+            # seconds go" (and the int8 crossover: device_transfer +
+            # device_dequant across artifact encodings on THIS link)
+            for child in load_span.children:
+                self.metrics.cold_stage_seconds.labels(child.name).observe(
+                    child.duration_s
+                )
 
     def _load_traced(self, model: Model, mid: ModelId, t0: float) -> None:
         import jax
@@ -295,6 +303,14 @@ class TPUModelRuntime(BaseRuntime):
                 model_def, host_params = load_artifact(
                     model.path, raw_quant=True
                 )
+            from tfservingcache_tpu.models.registry import QuantLeaf
+
+            has_quant = any(
+                isinstance(x, QuantLeaf)
+                for x in jax.tree_util.tree_leaves(
+                    host_params, is_leaf=lambda n: isinstance(n, QuantLeaf)
+                )
+            )
             if self.mesh is not None and model_def.partition_rules:
                 # multi-chip model: params sharded over the chip group per the
                 # family's partition rules; XLA partitions the computation and
@@ -303,7 +319,11 @@ class TPUModelRuntime(BaseRuntime):
                 # float leaves, not q/scale pairs.
                 from tfservingcache_tpu.parallel.sharding import shard_params
 
-                host_params = _dequantize_on_host(host_params)
+                if has_quant:
+                    # its own stage: the int8 crossover comparison must see
+                    # where the mesh path's dequant seconds go (host, here)
+                    with TRACER.span("host_dequant"):
+                        host_params = _dequantize_on_host(host_params)
                 with TRACER.span("device_transfer"):
                     params = shard_params(
                         host_params, model_def.partition_rules, self.mesh
@@ -314,10 +334,12 @@ class TPUModelRuntime(BaseRuntime):
                 # and dequantizes on device
                 with TRACER.span("device_transfer"):
                     params = packed_device_put(host_params, self._devices[0])
-                # own span: dequant compiles/compute must not inflate the
-                # transfer stage the q8 bench row exists to measure
-                with TRACER.span("device_dequant"):
-                    params = _dequantize_on_device(params)
+                if has_quant:
+                    # own span, quantized artifacts only: a no-op dequant
+                    # sample per bf16 load would blend the histogram the
+                    # cross-encoding comparison reads
+                    with TRACER.span("device_dequant"):
+                        params = _dequantize_on_device(params)
             key = model_def.cache_key
             # mesh-aware families (ring/context-parallel attention) build
             # their apply against THIS group's mesh; per-runtime jit cache

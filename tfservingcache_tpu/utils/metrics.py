@@ -120,6 +120,18 @@ class Metrics:
             "Device bytes held by cached prompt-prefix KV entries",
             registry=r,
         )
+        self.cold_stage_seconds = Histogram(
+            "tpusc_cold_stage_seconds",
+            "Per-stage cold-load time (provider_fetch/artifact_read/"
+            "device_transfer/device_dequant/host_dequant/compile_warmup/"
+            "transfer_sync; dequant stages appear for quantized artifacts "
+            "only, so encodings stay separable): "
+            "the in-production answer to 'where do my cold seconds go' and "
+            "to the int8-vs-bf16 crossover (compare device_transfer + "
+            "device_dequant across artifact encodings on YOUR link)",
+            ["stage"], registry=r,
+            buckets=(.005, .02, .05, .1, .25, .5, 1, 2, 5, 10, 30),
+        )
         self.group_reforms = Counter(
             "tpusc_group_reform_events_total",
             "Cross-host group failure-containment events",
