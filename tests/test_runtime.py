@@ -25,6 +25,28 @@ def runtime():
     rt.close()
 
 
+def test_cli_export_config_json(tmp_path, monkeypatch):
+    """`tpuserve export --config-json` merges overrides over the family's
+    defaults (and a custom seed varies the init) — users export custom-sized
+    artifacts without writing Python."""
+    import json
+
+    from tfservingcache_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("TPUSC_SERVING_PLATFORM", "cpu")
+    assert cli_main([
+        "export", "transformer_lm", str(tmp_path), "--name", "x",
+        "--seed", "3",
+        "--config-json", '{"d_model": 128, "n_layers": 1, "vocab_size": 256}',
+    ]) == 0
+    with open(tmp_path / "x" / "1" / "model.json") as f:
+        cfg = json.load(f)["config"]
+    assert cfg["d_model"] == 128 and cfg["n_layers"] == 1
+    assert cfg["n_heads"] == 8  # untouched default survives the merge
+    assert cli_main(["export", "transformer_lm", str(tmp_path),
+                     "--config-json", "notjson"]) == 2
+
+
 def test_cold_stage_histograms_recorded(tmp_path):
     """Every cold load feeds tpusc_cold_stage_seconds{stage} — operators
     answer 'where do my cold seconds go' (and the int8 crossover) from

@@ -30,6 +30,14 @@ def main(argv: list[str] | None = None) -> int:
         help="store large float weights as int8 + per-channel scales "
              "(device dequant at load; halves the cold-path transfer)",
     )
+    exp.add_argument(
+        "--config-json", default=None, metavar="JSON",
+        help="family config overrides as a JSON object, e.g. "
+             '\'{"d_model": 512, "n_layers": 8}\' (merged over the '
+             "family's defaults)",
+    )
+    exp.add_argument("--seed", type=int, default=0,
+                     help="parameter init seed")
     rep = sub.add_parser(
         "repack",
         help="rewrite an artifact in the current format (tpusc.v1 msgpack -> "
@@ -75,10 +83,25 @@ def main(argv: list[str] | None = None) -> int:
         run_server(cfg)
         return 0
     if args.cmd == "export":
+        import json as _json
+
         from tfservingcache_tpu.models.registry import export_artifact
 
+        config = None
+        if args.config_json is not None:
+            # empty string falls through json.loads and fails loudly like
+            # every other malformed value (a silently-ignored unset $CFG
+            # would export defaults the user didn't ask for)
+            try:
+                config = _json.loads(args.config_json)
+                if not isinstance(config, dict):
+                    raise ValueError("must be a JSON object")
+            except ValueError as e:
+                log.error("invalid --config-json: %s", e)
+                return 2
         path = export_artifact(args.model, args.dest, name=args.name,
-                               version=args.version, quantize=args.quantize)
+                               version=args.version, seed=args.seed,
+                               config=config, quantize=args.quantize)
         print(path)
         return 0
     if args.cmd == "repack":
