@@ -612,23 +612,32 @@ def bench_lm_throughput(runtime, variants: list[dict], batch: int,
 
 def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
                      seq: int = 512, config: dict | None = None,
-                     decode_batches: tuple = (1, 8, 32)) -> dict:
+                     decode_batches: tuple = (1, 8, 32),
+                     out: dict | None = None) -> dict:
     """Chip-sized LM (~284 M params): prefill MFU via chained on-device
-    timing of the jitted forward, decode tok/s at batch 1/8/32."""
+    timing of the jitted forward, decode tok/s at batch 1/8/32.
+
+    ``out`` (caller-owned) is filled progressively so a mid-section failure
+    still reports every stage that completed — the r5 chip_lm 413 threw away
+    19 minutes of cold-load evidence because the partial dict died with the
+    exception."""
     import numpy as np
 
     from tfservingcache_tpu.types import ModelId
     from tfservingcache_tpu.utils.benchtime import chained_device_time
 
     cfg = config or LM_CHIP_CONFIG
+    if out is None:
+        out = {}
     manager, runtime = _make_stack("transformer_lm", 1, tmp, hbm_gb=12,
                                    config=cfg)
     mid = ModelId("tenant0", 1)
     t0 = time.perf_counter()
     manager.ensure_servable(mid)
     cold_s = time.perf_counter() - t0
-    out = {"params": _lm_param_count(cfg), "cold_load_s": round(cold_s, 2),
-           "batch": batch, "seq": seq}
+    out.update({"params": _lm_param_count(cfg),
+                "cold_load_s": round(cold_s, 2),
+                "batch": batch, "seq": seq})
 
     loaded = runtime._resident.get(mid)
     import jax
@@ -640,16 +649,19 @@ def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
     )
 
     # chained timing needs a float first-arg to perturb; wrap so the embed
-    # table is the perturbed leaf and token ids stay closed over
+    # table is the perturbed leaf. ALL params ride as arguments — a closure
+    # over the remaining ~284M params becomes jaxpr constants, and the
+    # serialized compile request blows the tunnel's remote_compile body
+    # limit (r5 chip_lm: HTTP 413). Token ids (32 KB) may stay closed over.
     embed = loaded.params["embed"]
     rest = {k: v for k, v in loaded.params.items() if k != "embed"}
 
-    def fwd(embed):
+    def fwd(embed, rest):
         return loaded.model_def.apply({"embed": embed, **rest}, {"input_ids": ids})[
             "logits"
         ][:, -1, :]
 
-    t = chained_device_time(fwd, (embed,), iters=8)
+    t = chained_device_time(fwd, (embed, rest), iters=8)
     flops = 2.0 * _lm_param_count(cfg) * batch * seq
     out["prefill_ms"] = round(t * 1e3, 2)
     out["prefill_tok_s"] = round(batch * seq / t, 1)
@@ -1285,11 +1297,25 @@ def run(args) -> dict:
             detail["flash_kernel"] = {"error": f"{type(e).__name__}: {e}"}
 
     if want("chip_lm") and on_tpu:
+        # attach the progressive dict BEFORE the section so the in-section
+        # partial flush (and a later SIGKILL salvage) carries every stage
+        # that completed even if the handler below never runs
+        part: dict = {}
+        detail["chip_lm"] = part
         try:
             with _section("chip_lm"):
-                detail["chip_lm"] = bench_chip_model(tmp, device_kind)
+                bench_chip_model(tmp, device_kind, out=part)
         except Exception as e:  # noqa: BLE001
-            detail["chip_lm"] = {"error": f"{type(e).__name__}: {e}"}
+            import traceback
+
+            root = os.path.dirname(os.path.abspath(__file__))
+            frames = traceback.extract_tb(e.__traceback__)
+            part["error"] = f"{type(e).__name__}: {e}"
+            part["error_at"] = next(
+                (f"{os.path.basename(f.filename)}:{f.lineno} in {f.name}"
+                 for f in reversed(frames)
+                 if f.filename.startswith(root)
+                 or "tfservingcache" in f.filename), "?")
 
     mnist_variants = (
         _input_variants("mnist_cnn", args.batch, None)

@@ -54,7 +54,17 @@ def test_timed_inputs_never_repeat_warmup_inputs(monkeypatch, base):
         lambda x: x * 2.0, (jnp.full((4,), base, jnp.float32),),
         iters=4, repeats=2,
     )
-    warmup, timed = seen[:2], seen[2:]
-    assert len(timed) == 4  # repeats * (1-iter + n-iter)
-    assert all(t not in warmup for t in timed)
+    # Each adaptive round issues exactly 2 warmup calls (args[0] verbatim)
+    # then repeats*2 timed calls — reconstruct rounds POSITIONALLY so a
+    # timed call that regressed to replay the warmup value is caught, not
+    # silently reclassified as warmup (the transport-cache hole this test
+    # exists to pin).
+    per_round = 2 + 2 * 2  # 2 warmups + repeats(=2) * (1-iter + n-iter)
+    assert len(seen) % per_round == 0, seen
+    warmup_vals, timed = set(), []
+    for i in range(0, len(seen), per_round):
+        warmup_vals.update(seen[i:i + 2])
+        timed.extend(seen[i + 2:i + per_round])
+    assert len(timed) >= 4  # at least one round of repeats * (1-iter + n-iter)
+    assert all(t not in warmup_vals for t in timed)
     assert len(set(timed)) == len(timed)

@@ -22,6 +22,7 @@ def chained_device_time(
     args: Sequence[Any],
     iters: int = 16,
     repeats: int = 3,
+    max_iters: int = 1024,
 ) -> float:
     """Seconds per call of ``fn(*args)`` measured on device.
 
@@ -36,6 +37,15 @@ def chained_device_time(
     flattens both sides of a comparison to the noise floor. The per-iter
     estimate is the median over ``repeats`` independent (1-iter, n-iter)
     pairs.
+
+    ``iters`` is a STARTING chain length, not a fixed one: if the n-iter run
+    does not take at least 2x the 1-iter run (median over the round), the
+    subtraction is dispatch noise and the chain grows 4x — up to
+    ``max_iters`` — re-compiling the longer chain each time. Budget
+    accordingly for very cheap ``fn``: worst case ~4 extra compiles and a
+    ``max_iters``-long chain per call. If dominance is never reached even at
+    ``max_iters``, the (noisy) max_iters estimate is returned rather than
+    failing.
     """
     import jax
     import jax.numpy as jnp
@@ -67,16 +77,36 @@ def chained_device_time(
         jax.block_until_ready(a0)
         return (a0,) + args[1:]
 
-    float(loop(args, 1))        # compile the 1-iter program
-    float(loop(args, iters))    # compile the n-iter program
-    estimates = []
-    for _ in range(repeats):
-        a_short, a_long = fresh(), fresh()
-        t0 = time.perf_counter()
-        float(loop(a_short, 1))
-        t1 = time.perf_counter()
-        float(loop(a_long, iters))
-        t2 = time.perf_counter()
-        estimates.append(max((t2 - t1) - (t1 - t0), 1e-9) / (iters - 1))
-    estimates.sort()
+    def measure(n: int) -> list[tuple[float, float]]:
+        float(loop(args, 1))    # compile the 1-iter program
+        float(loop(args, n))    # compile the n-iter program
+        pairs = []
+        for _ in range(repeats):
+            a_short, a_long = fresh(), fresh()
+            t0 = time.perf_counter()
+            float(loop(a_short, 1))
+            t1 = time.perf_counter()
+            float(loop(a_long, n))
+            t2 = time.perf_counter()
+            pairs.append((t1 - t0, t2 - t1))
+        return pairs
+
+    # A fast kernel at small iters can vanish under dispatch overhead: the
+    # n-iter run takes barely longer than the 1-iter run, the subtraction
+    # lands at (or below) zero, and the caller would report a nonsense
+    # "0.000 ms" (the r5 kernel-check small-shape artifact). Grow the chain
+    # until the long run clearly dominates the short one, so the subtraction
+    # carries signal, not noise.
+    while True:
+        pairs = measure(iters)
+        shorts = sorted(s for s, _ in pairs)
+        longs = sorted(l for _, l in pairs)
+        if longs[len(longs) // 2] >= 2.0 * shorts[len(shorts) // 2]:
+            break
+        if iters >= max_iters:
+            break
+        iters = min(iters * 4, max_iters)
+    estimates = sorted(
+        max(l - s, 1e-9) / (iters - 1) for s, l in pairs
+    )
     return estimates[len(estimates) // 2]

@@ -198,12 +198,14 @@ def salvage_partial(name: str, partial_path: str) -> None:
 
 def run_unit(name: str, argv: list[str], budget_s: float) -> bool:
     os.makedirs(RUNS, exist_ok=True)
-    out_path = os.path.join(RUNS, f"{name}.json")
+    is_bench = argv[0] == "bench.py"
+    # non-bench units emit pytest text, not JSON — a .json name would make
+    # bench.py's collect_watcher_evidence() glob choke on it (and skip it)
+    out_path = os.path.join(RUNS, f"{name}.json" if is_bench else f"{name}.txt")
     log_path = os.path.join(RUNS, f"{name}.log")
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     partial_path = os.path.join(RUNS, f"{name}.partial.json")
     env["TPUSC_BENCH_PARTIAL"] = partial_path
-    is_bench = argv[0] == "bench.py"
     cmd = [sys.executable, *argv]
     if is_bench:
         cmd += ["--init-timeout-s", "150", "--budget-s", str(budget_s)]
