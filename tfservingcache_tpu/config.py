@@ -39,14 +39,17 @@ class ServingConfig:
     platform: str = ""                     # "" = default jax backend; "cpu" forces CPU
     # adaptive micro-batching (TF Serving --enable_batching equivalent,
     # in-process now): 0 disables; concurrent same-shape requests within the
-    # window coalesce into one device call. Default 0 (OFF): every
-    # measurement taken so far favors it — the only TPU datum (BENCH_r02:
-    # batching cost 31% REST QPS on mnist) and the CPU LM REST rows
-    # (BENCH_r04: 45.9 QPS batched vs 57.8 unbatched). Enable per-deployment
-    # (set 1-2 ms) only when profiling shows concurrent same-shape warm
-    # traffic whose batched device call beats the window latency — e.g.
-    # many-client gRPC fan-in on one large model (bench.py `batcher_qps`
-    # section measures exactly this pair).
+    # window coalesce into one device call. Default 0 (OFF) per measured
+    # evidence: on the chip (r5, tpu_runs/) LM REST loses consistently with
+    # batching (36-66 vs 100-105 QPS) as does r2's mnist REST (-31%); the
+    # wins are protocol/family-specific and window-noisy (r5 full run:
+    # mnist REST batch 202 vs 161, mnist gRPC batch 199 vs 241 — the
+    # OPPOSITE split of the same day's batcher_qps window). A default must
+    # hold across families; off does. Enable per-deployment (set 1-2 ms)
+    # only when profiling shows concurrent same-shape warm traffic whose
+    # batched device call beats the window latency — e.g. many-client
+    # fan-in on one cheap-decode model (bench.py `batcher_qps` section
+    # measures exactly this pair).
     batch_window_ms: float = 0.0
     batch_max_size: int = 64
     # Prefix KV cache for :generate (runtime/prefix_cache.py): byte budget
