@@ -1,0 +1,47 @@
+"""Bounded child-process probe of the configured JAX backend.
+
+The image registers the axon PJRT plugin (a tunneled TPU). When the tunnel
+is down, in-process backend init blocks for ~20 minutes before raising
+Unavailable — any caller that wants to *decide* (fall back to CPU, skip a
+hardware path, report a diagnostic) must ask a child process with a timeout
+instead of touching ``jax.devices()`` itself. ``bench.py`` and
+``tools/tpu_bench_watcher.py`` carry their own battle-tested variants whose
+exact behavior is baked into committed artifacts; new callers should use
+this one rather than hand-rolling a fourth.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+
+def backend_answers(
+    timeout_s: float = 90.0, retries: int = 2, backoff_s: float = 5.0
+) -> tuple[bool, str]:
+    """(ok, diagnostic): does the configured backend come up in a child?
+
+    Retries transient failures so a momentary tunnel blip doesn't silently
+    downgrade the caller to CPU. The child inherits the environment, so it
+    resolves exactly the backend the caller's in-process init would.
+    """
+    code = (
+        "import jax; d = jax.devices();"
+        "print('ok', d[0].platform, len(d))"
+    )
+    last = ""
+    for attempt in range(retries + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], timeout=timeout_s,
+                capture_output=True, text=True,
+            )
+            if r.returncode == 0 and r.stdout.startswith("ok"):
+                return True, r.stdout.strip()
+            last = (r.stderr or r.stdout).strip()[-300:]
+        except subprocess.TimeoutExpired:
+            last = f"backend init did not answer within {timeout_s:.0f}s"
+        if attempt < retries:
+            time.sleep(backoff_s * (attempt + 1))
+    return False, last
