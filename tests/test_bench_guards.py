@@ -1,0 +1,96 @@
+"""Guards for bench.py's measurement-validity logic.
+
+The bench is evidence infrastructure: when it silently measures the wrong
+thing the damage outlives the round (the r5 full run served a stale 17.8M
+toy artifact in the chip-model section and published an impossible
+"MFU 8.29"). These tests pin the guards that turn silent nonsense into
+loud failures, plus the aligned-arm param construction whose regression
+would quietly change what the speculative ceiling row measures.
+"""
+
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+TINY = {
+    "vocab_size": 256, "d_model": 64, "n_layers": 2, "n_heads": 4,
+    "n_kv_heads": 2, "d_ff": 128, "max_seq": 256,
+}
+
+
+def test_damped_aligned_params_shares_and_damps():
+    from tfservingcache_tpu.models.registry import build
+
+    import jax
+
+    model = build("transformer_lm", TINY)
+    params = model.init(jax.random.PRNGKey(0))
+    damped = bench._damped_aligned_params(params, scale=0.05)
+
+    # embed/ln_f shared by identity (the draft must share the target's
+    # embedding for token-level agreement to be meaningful)
+    assert damped["embed"] is params["embed"]
+    assert damped["ln_f"] is params["ln_f"]
+    # residual writes damped, everything else untouched
+    for orig, d in zip(params["layers"], damped["layers"]):
+        np.testing.assert_allclose(
+            np.asarray(d["attn"]["wo"], np.float32),
+            np.asarray(orig["attn"]["wo"], np.float32) * 0.05, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(d["mlp"]["w2"], np.float32),
+            np.asarray(orig["mlp"]["w2"], np.float32) * 0.05, rtol=1e-6)
+        for k in ("wq", "wk", "wv"):
+            assert d["attn"][k] is orig["attn"][k]
+        for k in ("w1", "w3"):
+            assert d["mlp"][k] is orig["mlp"][k]
+        assert d["ln1"] is orig["ln1"] and d["ln2"] is orig["ln2"]
+    # the damped model's last-token argmax matches its own early-exit
+    # prefix — the property the aligned arm's acceptance ceiling rests on
+    ids = {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, TINY["vocab_size"], (1, 16)),
+        jnp.int32)}
+    full = model.apply(damped, ids)["logits"][0, -1]
+    exit_params = {
+        "embed": damped["embed"], "ln_f": damped["ln_f"],
+        "layers": damped["layers"][:1],
+    }
+    exit_model = build("transformer_lm", dict(TINY, n_layers=1))
+    early = exit_model.apply(exit_params, ids)["logits"][0, -1]
+    assert int(jnp.argmax(full)) == int(jnp.argmax(early))
+
+
+def test_chip_section_rejects_stale_resident_model(tmp_path):
+    """A pre-existing tenant0@1 artifact of a DIFFERENT config in the chip
+    section's (isolated) store must trip the param-count assert, not be
+    silently measured (the r5 'MFU 8.29' failure mode)."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.models.registry import export_artifact
+    from tfservingcache_tpu.types import ModelId
+
+    tmp = str(tmp_path)
+    other = dict(TINY, d_model=128, d_ff=256)  # different size, same family
+    # Reproduce the real r5 pollution path: the section's DISK CACHE (not
+    # the store — _make_stack re-exports that) already holds tenant0@1 with
+    # a different config. Artifacts are immutable per (name, version), so
+    # the cached copy wins over the freshly exported store artifact.
+    store = os.path.join(tmp, "chip", "store-transformer_lm")
+    export_artifact("transformer_lm", store, name="tenant0", version=1,
+                    seed=0, config=other)
+    provider = DiskModelProvider(store)
+    cache = ModelDiskCache(
+        os.path.join(tmp, "chip", "cache-transformer_lm"),
+        capacity_bytes=64 << 30,
+    )
+    mid = ModelId("tenant0", 1)
+    cache.put(provider.load_model("tenant0", 1, cache.model_path(mid)))
+    assert cache.get(mid) is not None
+    with pytest.raises(AssertionError, match="stale artifact"):
+        bench.bench_chip_model(tmp, "cpu", batch=1, seq=16, config=TINY,
+                               decode_batches=())
