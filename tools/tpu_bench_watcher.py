@@ -44,7 +44,7 @@ UNITS: list[tuple[str, list[str], float]] = [
      1500.0),
     ("batcher_qps", ["bench.py", "--only", "mnist_qps,lm_qps,lm_throughput"],
      1800.0),
-    ("gen_features", ["bench.py", "--only", "spec_decode,prefix_gen"], 1200.0),
+    ("gen_features", ["bench.py", "--only", "spec_decode,prefix_gen"], 1500.0),
     ("routed_soak", ["bench.py", "--only", "routed,tenant_soak"], 1500.0),
     ("full", ["bench.py"], 2100.0),
 ]
