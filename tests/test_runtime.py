@@ -102,6 +102,13 @@ def test_cli_warm_populates_compile_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUSC_SERVING_COMPILE_CACHE_DIR", str(cache_dir))
     monkeypatch.setenv("TPUSC_SERVING_PLATFORM", "cpu")
     prior_cache_dir = jax.config.jax_compilation_cache_dir
+    # jax initializes the persistent compilation cache AT MOST ONCE per
+    # process: if any earlier test compiled with a cache dir configured,
+    # this test's fresh dir would silently never receive entries (order-
+    # dependent flake). Reset to pristine so warm's dir takes effect.
+    from jax._src import compilation_cache as _cc
+
+    _cc.reset_cache()
     try:
         # defaults (128/32) exceed max_seq 64: warm must CLAMP, not crash
         assert cli_main(["warm", art, "--batches", "1,2"]) == 0
@@ -117,6 +124,7 @@ def test_cli_warm_populates_compile_cache(tmp_path, monkeypatch):
         # the runtime flips the PROCESS-GLOBAL jax compilation cache dir;
         # later tests' cold-compile behavior must not depend on this tmp dir
         jax.config.update("jax_compilation_cache_dir", prior_cache_dir)
+        _cc.reset_cache()  # un-pin the tmp dir for later tests too
 
 
 def test_next_bucket():
