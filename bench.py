@@ -653,11 +653,15 @@ def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
     n_loaded = sum(
         int(x.size) for x in jax.tree_util.tree_leaves(loaded.params)
     )
-    assert n_loaded == _lm_param_count(cfg), (
-        f"resident model has {n_loaded} params but the chip config implies "
-        f"{_lm_param_count(cfg)} — a stale artifact/cache is being served; "
-        "every downstream number in this section would be wrong"
-    )
+    if n_loaded != _lm_param_count(cfg):
+        # explicit raise (not assert): the guard must survive python -O —
+        # silently measuring the wrong model is the worst bench outcome
+        raise AssertionError(
+            f"resident model has {n_loaded} params but the chip config "
+            f"implies {_lm_param_count(cfg)} — a stale artifact/cache is "
+            "being served; every downstream number in this section would "
+            "be wrong"
+        )
 
     ids = jnp.asarray(
         np.random.default_rng(3).integers(0, cfg["vocab_size"], (batch, seq)),
