@@ -1,0 +1,57 @@
+"""utils/backend_probe: the bounded child-process backend probe.
+
+Hermetic: the probe's child interpreter is swapped for stub scripts, because
+on this harness ANY real child inherits the axon plugin, which overrides
+JAX_PLATFORMS (even bogus values) and blocks on the down tunnel — the exact
+behavior the probe exists to bound, but useless for fast unit tests.
+"""
+
+import stat
+import sys
+import time
+
+from tfservingcache_tpu.utils import backend_probe
+
+
+def _stub(tmp_path, body: str) -> str:
+    p = tmp_path / "fake_python"
+    p.write_text(f"#!{sys.executable}\nimport sys\n{body}\n")
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return str(p)
+
+
+def test_healthy_child_answers(tmp_path, monkeypatch):
+    exe = _stub(tmp_path, "print('ok cpu 1')")
+    monkeypatch.setattr(backend_probe.sys, "executable", exe)
+    ok, diag = backend_probe.backend_answers(timeout_s=30.0, retries=0)
+    assert ok and diag == "ok cpu 1"
+
+
+def test_failing_child_reports_stderr_and_retries(tmp_path, monkeypatch):
+    marks = tmp_path / "attempts"
+    exe = _stub(
+        tmp_path,
+        "open(r'%s', 'a').write('x')\n"
+        "sys.stderr.write('backend exploded')\nsys.exit(1)" % marks,
+    )
+    monkeypatch.setattr(backend_probe.sys, "executable", exe)
+    t0 = time.perf_counter()
+    ok, diag = backend_probe.backend_answers(
+        timeout_s=30.0, retries=2, backoff_s=0.1
+    )
+    assert not ok
+    assert "backend exploded" in diag
+    assert marks.read_text() == "xxx"  # initial attempt + 2 retries
+    assert time.perf_counter() - t0 < 25.0  # child verdict, not timeouts
+
+
+def test_hung_child_hits_timeout_with_diagnostic(tmp_path, monkeypatch):
+    exe = _stub(tmp_path, "import time\ntime.sleep(3600)")
+    monkeypatch.setattr(backend_probe.sys, "executable", exe)
+    t0 = time.perf_counter()
+    ok, diag = backend_probe.backend_answers(timeout_s=1.5, retries=1,
+                                             backoff_s=0.1)
+    dt = time.perf_counter() - t0
+    assert not ok
+    assert "did not answer within" in diag
+    assert 2.5 < dt < 30.0  # two bounded attempts, no 20-minute hang
