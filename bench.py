@@ -190,7 +190,30 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
         )
     )
     manager = CacheManager(provider, cache, runtime)
+    # crash-path leak tracking: a section that errors mid-body never
+    # reaches its manager.close(), leaving runtime threads + pinned HBM
+    # under later sections' measurements on the one chip. _section() closes
+    # exactly the stacks ITS body created when it exits on an exception —
+    # healthy-path stacks deliberately outlive their creating section (the
+    # qps sections measure the cold sections' stacks), so there is no
+    # deregistration on normal close; close() is idempotent (clear-based),
+    # making the run()-end sweep safe.
+    _LIVE_STACKS.append(manager)
     return manager, runtime
+
+
+_LIVE_STACKS: list = []
+
+
+def _close_stacks_beyond(depth: int) -> None:
+    """Close (idempotently) every stack registered after ``depth``."""
+    while len(_LIVE_STACKS) > depth:
+        m = _LIVE_STACKS.pop()
+        try:
+            m.close()
+        except Exception as e:  # noqa: BLE001 - cleanup must not cascade
+            print(f"[bench] stack close failed: {e}", file=sys.stderr,
+                  flush=True)
 
 
 # where the live partial lands after every section: a killed/wedged run
@@ -218,8 +241,16 @@ def _section(name: str):
     where); flush the live partial to PARTIAL_OUT so even a kill -9 after
     this section keeps its numbers."""
     t0 = time.perf_counter()
+    depth = len(_LIVE_STACKS)
     try:
         yield
+    except BaseException:
+        # close only the stacks THIS section created: its body never reached
+        # their manager.close(), and they must not haunt later sections.
+        # Healthy-path stacks (depth and below) stay — later sections
+        # measure them by design.
+        _close_stacks_beyond(depth)
+        raise
     finally:
         dt = time.perf_counter() - t0
         PARTIAL.setdefault("section_s", {})[name] = round(dt, 1)
@@ -1591,6 +1622,7 @@ def run(args) -> dict:
         except Exception as e:  # noqa: BLE001
             detail["tenant_soak"] = {"error": f"{type(e).__name__}: {e}"}
 
+    _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):
         if fam in detail:
             detail[fam] = {
