@@ -65,6 +65,37 @@ def test_damped_aligned_params_shares_and_damps():
     assert int(jnp.argmax(full)) == int(jnp.argmax(early))
 
 
+def test_failed_section_closes_only_its_own_stacks(tmp_path):
+    """_section() crash-path cleanup: a failing section's stacks are closed,
+    but stacks created by EARLIER sections must survive — the warm QPS
+    sections measure the cold sections' stacks by design, and an over-eager
+    sweep would silently turn warm rows into cold reload measurements."""
+    from tfservingcache_tpu.types import ModelId
+
+    tmp = str(tmp_path)
+    base_depth = len(bench._LIVE_STACKS)
+    keep_mgr, keep_rt = bench._make_stack("half_plus_two", 1, tmp)
+    keep_mid = ModelId("tenant0", 1)
+    keep_mgr.ensure_servable(keep_mid)
+    try:
+        with pytest.raises(RuntimeError):
+            with bench._section("guards_failing_section"):
+                m2, rt2 = bench._make_stack(
+                    "half_plus_two", 1, os.path.join(tmp, "inner"))
+                m2.ensure_servable(keep_mid)
+                assert rt2.is_loaded(keep_mid)
+                raise RuntimeError("section body exploded")
+        # the failing section's stack was closed ...
+        assert not rt2.is_loaded(keep_mid)
+        # ... the earlier section's stack was not
+        assert keep_rt.is_loaded(keep_mid)
+        assert len(bench._LIVE_STACKS) == base_depth + 1
+    finally:
+        bench._close_stacks_beyond(base_depth)
+    assert len(bench._LIVE_STACKS) == base_depth
+    keep_mgr.close()  # double-close after the sweep must be harmless
+
+
 def test_chip_section_rejects_stale_resident_model(tmp_path):
     """A pre-existing tenant0@1 artifact of a DIFFERENT config in the chip
     section's (isolated) store must trip the param-count assert, not be
