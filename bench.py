@@ -161,7 +161,9 @@ def _peak_flops(device_kind: str) -> float | None:
 
 def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
                 config: dict | None = None, resident_cap: int | None = None,
-                quantize: str | None = None, prefix_cache_bytes: int = 0):
+                quantize: str | None = None, prefix_cache_bytes: int = 0,
+                cold_load_pipeline: bool | None = None,
+                compile_cache_dir: str | None = None):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
@@ -185,8 +187,15 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
             # the A4 persistent compile cache, at a path that survives runs:
             # a restarted node re-hits its compiles instead of recompiling
             # the world (SURVEY §7 hard part (a) calls this load-bearing for
-            # the <=2 s cold target) — and the bench measures that behavior
-            compile_cache_dir=os.path.expanduser("~/.cache/tpusc-xla"),
+            # the <=2 s cold target) — and the bench measures that behavior.
+            # cold_pipeline arms override it with per-arm throwaway dirs so
+            # neither arm inherits the other's compiles.
+            compile_cache_dir=(
+                compile_cache_dir
+                or os.path.expanduser("~/.cache/tpusc-xla")
+            ),
+            **({} if cold_load_pipeline is None
+               else {"cold_load_pipeline": cold_load_pipeline}),
         )
     )
     manager = CacheManager(provider, cache, runtime)
@@ -264,7 +273,7 @@ def _section(name: str):
 SECTION_GROUPS = (
     "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
-    "prefix_gen", "zoo_cold", "tenant_soak",
+    "prefix_gen", "zoo_cold", "tenant_soak", "cold_pipeline",
 )
 
 
@@ -712,10 +721,15 @@ def bench_chip_model(tmp: str, device_kind: str, batch: int = 16,
             "logits"
         ][:, -1, :]
 
-    t = chained_device_time(fwd, (embed, rest), iters=8)
+    t, t_ok = chained_device_time(fwd, (embed, rest), iters=8,
+                                  return_valid=True)
     flops = 2.0 * _lm_param_count(cfg) * batch * seq
     out["prefill_ms"] = round(t * 1e3, 2)
     out["prefill_tok_s"] = round(batch * seq / t, 1)
+    if not t_ok:
+        # the chain never dominated dispatch overhead even at max_iters —
+        # the MFU row below is an upper bound on noise, not a measurement
+        out["prefill_timing_noisy"] = True
     peak = _peak_flops(device_kind)
     if peak:
         out["prefill_mfu"] = round(flops / t / peak, 4)
@@ -806,11 +820,13 @@ def bench_flash_kernel() -> dict:
         err = float(
             jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
         )
-        t_flash = chained_device_time(
-            lambda q, k, v: flash_attention(q, k, v, causal=True), (q, k, v)
+        t_flash, flash_ok = chained_device_time(
+            lambda q, k, v: flash_attention(q, k, v, causal=True), (q, k, v),
+            return_valid=True,
         )
-        t_ref = chained_device_time(
-            lambda q, k, v: attention_reference(q, k, v, causal=True), (q, k, v)
+        t_ref, ref_ok = chained_device_time(
+            lambda q, k, v: attention_reference(q, k, v, causal=True),
+            (q, k, v), return_valid=True,
         )
         results[label] = {
             "shape_bhsd": [b, hq, s, d],
@@ -820,6 +836,11 @@ def bench_flash_kernel() -> dict:
             "jnp_ms": round(t_ref * 1e3, 3),
             "speedup": round(t_ref / t_flash, 2),
         }
+        if not (flash_ok and ref_ok):
+            # either side's chain never dominated dispatch overhead: the
+            # speedup ratio is noise-over-noise — flag it so the row can't
+            # be quoted as a kernel verdict (the r2 failure mode, twice)
+            results[label]["timing_noisy"] = True
 
     # streamed long-context row: S=16k dispatches the 3D-grid kernel by
     # size. No jnp comparison — the reference would materialize a 4 GB
@@ -833,9 +854,9 @@ def bench_flash_kernel() -> dict:
         q = jax.random.normal(ks[0], (b, h, s, d), jnp.bfloat16)
         k = jax.random.normal(ks[1], (b, h, s, d), jnp.bfloat16)
         v = jax.random.normal(ks[2], (b, h, s, d), jnp.bfloat16)
-        t = chained_device_time(
+        t, long_ok = chained_device_time(
             lambda q, k, v: flash_attention(q, k, v, causal=True), (q, k, v),
-            iters=4,
+            iters=4, return_valid=True,
         )
         flops = 2 * 2 * b * h * (s * s / 2) * d
         results["long_context_16k_streamed"] = {
@@ -844,6 +865,7 @@ def bench_flash_kernel() -> dict:
             "tf_s": round(flops / t / 1e12, 1),
             "jnp_ms": None,
             "note": "jnp reference infeasible at 16k (4 GB score matrix)",
+            **({} if long_ok else {"timing_noisy": True}),
         }
     except Exception as e:  # noqa: BLE001 - the proven rows stand on their own
         results["long_context_16k_streamed"] = {
@@ -946,6 +968,16 @@ def bench_tenant_soak(tmp: str, tenants: int = 1000, requests: int = 3000) -> di
         m for m in (ModelId(f"tenant{i}", 1) for i in range(tenants))
         if runtime.is_loaded(m)
     ]
+    if not resident:
+        # guard before worker spawn: with no resident tenants every _hammer
+        # thread would die on resident[... % 0] (ZeroDivisionError) and the
+        # section would report a confusing modulo crash instead of the
+        # actual condition (eviction left the cache empty post-sweep)
+        raise RuntimeError(
+            "warm-hit QPS phase found no resident tenants after the cold "
+            "sweep — eviction emptied the cache, so there is no warm set "
+            "to hammer; check resident_cap vs per-tenant HBM footprint"
+        )
     warm_n = 0
     warm_stop = time.perf_counter() + 5.0
     warm_lock = threading.Lock()
@@ -1011,6 +1043,227 @@ def bench_tenant_soak(tmp: str, tenants: int = 1000, requests: int = 3000) -> di
         "warm_hit_qps": round(warm_qps, 1),
         "warm_hit_threads": warm_threads,
     }
+
+
+# cold_pipeline presets: both families are deliberately THIN AND DEEP.
+# On a 1-core harness the only true idle time the pipeline can overlap
+# into is the fetch's wire sleep, so the presets are sleep-balanced:
+#   - block count sets the XLA compile seconds (the stage the pipeline
+#     hides inside the fetch) — it must fit INSIDE the wire sleep with
+#     margin, or the concurrent compile spills into the fetch/transfer
+#     and inflates the pipelined arm instead of helping it;
+#   - narrow d_model keeps the AOT warmup execute (paid in transfer_sync,
+#     the pipelined arm's only extra serial cost) small;
+#   - the vocab/embed table adds fetch bytes with near-zero compile cost,
+#     which is the knob that buys sleep margin.
+COLD_PIPE_LM_CONFIG = {
+    "vocab_size": 65536,
+    "d_model": 512,
+    "n_layers": 24,
+    "n_heads": 8,
+    "n_kv_heads": 4,
+    "d_ff": 1024,
+    "max_seq": 128,
+    "rope_theta": 10000.0,
+    "dtype": "bfloat16",
+}
+
+COLD_PIPE_T5_CONFIG = {
+    "vocab_size": 98304,
+    "d_model": 512,
+    "n_layers": 10,
+    "n_heads": 8,
+    "d_ff": 1024,
+    "rel_buckets": 32,
+    "rel_max_dist": 128,
+    "dtype": "bfloat16",
+}
+
+# Simulated object-store wire rate for the cold_pipeline section. A cold
+# fetch in production comes over a network (S3/GCS/Azure — same regime as
+# the injected-latency parallel-fetch row above); a page-cache-warm local
+# copy would erase stage (c) of the pipeline entirely and, on this 1-core
+# harness, leave no IO wait for ANY stage to overlap into. Both arms pay
+# identical per-file wire time, so the comparison stays apples-to-apples.
+# 30 MB/s is a single-stream cross-region object-store GET — the slow end
+# of the regime the repo's parallel-fetch feature exists to mitigate.
+COLD_PIPE_NET_MBPS = 30.0
+
+# fresh cold loads per arm; each family/arm reports its fastest rep
+_COLD_PIPE_REPS = 2
+
+
+class _NetSimDiskProvider:
+    """Wrap a DiskModelProvider with a byte-proportional wire delay.
+
+    The sleep releases the GIL, so the pipelined arm's in-flight AOT
+    compile runs at full speed during the fetch — exactly the overlap the
+    cold pipeline is built around — while the serialized arm pays the same
+    wire time strictly before its compile starts."""
+
+    def __init__(self, inner, mbps: float) -> None:
+        self._inner = inner
+        self._bps = float(mbps) * (1 << 20)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _wire(self, path: str) -> None:
+        time.sleep(os.path.getsize(path) / self._bps)
+
+    def load_model(self, name: str, version: int, dest_dir: str):
+        src = self._inner._find_src_path(name, version)
+        for root, _dirs, files in os.walk(src):
+            for fn in files:
+                self._wire(os.path.join(root, fn))
+        return self._inner.load_model(name, version, dest_dir)
+
+    def load_model_streaming(self, name, version, dest_dir, on_file=None):
+        if on_file is None:
+            return self.load_model(name, version, dest_dir)
+        src = self._inner._find_src_path(name, version)
+
+        def delayed_on_file(rel, local):
+            # the inner provider notifies AFTER copying each file; charge
+            # that file's wire time here so each file "arrives" at the
+            # simulated rate before the runtime hears about it
+            self._wire(os.path.join(src, rel))
+            on_file(rel, local)
+
+        return self._inner.load_model_streaming(
+            name, version, dest_dir, on_file=delayed_on_file
+        )
+
+
+def _find_span(span: dict, name: str) -> dict | None:
+    """Depth-first search of a TRACER.recent() span tree — the load span
+    nests under the manager's ensure_servable span, never at the root."""
+    if span.get("name") == name:
+        return span
+    for c in span.get("children", []):
+        hit = _find_span(c, name)
+        if hit is not None:
+            return hit
+    return None
+
+
+def bench_cold_pipeline(tmp: str) -> dict:
+    """Pipelined vs serialized cold load, same artifact bytes, per family.
+
+    Each arm gets a FRESH stack, store, disk cache, and — critically — its
+    own throwaway XLA compile-cache dir, plus ``jax.clear_caches()`` before
+    it runs: the arms must not share compiles through either the in-process
+    jit cache or the persistent A4 cache, or the second arm's compile stage
+    collapses to a lookup and the comparison is meaningless. Inputs are at
+    batch=1/seq=1, the warmup signature, so neither arm pays a second
+    compile inside its first predict.
+
+    The provider is wrapped with a simulated object-store wire rate
+    (``COLD_PIPE_NET_MBPS``, identical for both arms): production cold
+    fetches cross a network, and on this 1-core harness a page-cache-warm
+    local copy leaves no IO wait at all — the serialized arm would then be
+    a strict lower bound no pipeline can beat, which is the wrong question.
+    The chip row (pending capture) needs no simulation: H2D is real DMA and
+    the compile runs on otherwise-idle host cores.
+
+    Each arm reports its best of ``_COLD_PIPE_REPS`` fresh cold loads (the
+    standard minimum-latency estimator): this single-core guest sees 2-3x
+    hypervisor-steal swings on compile seconds between runs, and one slow
+    draw on either arm would otherwise decide the comparison.
+
+    Reported per family: per-arm cold_first_s (ensure_servable + first
+    predict), the per-arm cold_overlap_ratio from the load span
+    (Σ(stage)/wall; >1 means stages genuinely overlapped), per-arm stage
+    seconds, and the speedup. This section IS the acceptance evidence for
+    the pipelined cold load, so it fails loudly rather than quietly
+    reporting an arm that didn't take its intended path."""
+    import jax
+
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.tracing import TRACER
+
+    out: dict = {}
+    for family, config in (
+        ("transformer_lm", COLD_PIPE_LM_CONFIG),
+        ("t5", COLD_PIPE_T5_CONFIG),
+    ):
+        fam: dict = {}
+        out[family] = fam
+        best: dict[str, dict] = {}
+        # reps INTERLEAVED across arms (ser, pipe, ser, pipe): this guest's
+        # hypervisor-steal windows last minutes, so back-to-back reps of
+        # one arm land in the same window and best-of-N stops helping
+        for rep in range(_COLD_PIPE_REPS):
+            for arm in ("serialized", "pipelined"):
+                arm_tmp = os.path.join(tmp, f"{family}-{arm}-r{rep}")
+                jax.clear_caches()
+                manager, runtime = _make_stack(
+                    family, 1, arm_tmp, config=config,
+                    cold_load_pipeline=(arm == "pipelined"),
+                    compile_cache_dir=os.path.join(arm_tmp, "xla-cache"),
+                )
+                manager.provider = _NetSimDiskProvider(
+                    manager.provider, COLD_PIPE_NET_MBPS
+                )
+                want_pipe = arm == "pipelined"
+                if runtime.cold_pipeline_enabled != want_pipe:
+                    raise RuntimeError(
+                        f"{family}/{arm}: cold_pipeline_enabled is "
+                        f"{runtime.cold_pipeline_enabled}, arm intended "
+                        f"{want_pipe} — the comparison would be arm vs itself"
+                    )
+                # page-cache pre-warm: the export above just wrote the
+                # store, but read it back explicitly so BOTH arms fetch
+                # from warm pages regardless of export buffering behavior
+                store = os.path.join(arm_tmp, f"store-{family}")
+                for root, _dirs, files in os.walk(store):
+                    for fn in files:
+                        with open(os.path.join(root, fn), "rb") as f:
+                            while f.read(1 << 22):
+                                pass
+                inputs = _example_inputs(family, 1, config, lm_seq=1)
+                TRACER.clear()
+                mid = ModelId("tenant0", 1)
+                t0 = time.perf_counter()
+                manager.ensure_servable(mid)
+                runtime.predict(mid, inputs)
+                cold_s = time.perf_counter() - t0
+                load = root = None
+                for trace in TRACER.recent(8):
+                    load = _find_span(trace, "load")
+                    if load is not None:
+                        root = trace
+                        break
+                if load is None:
+                    raise RuntimeError(
+                        f"{family}/{arm}: no load span in the trace ring — "
+                        "cold_first_s cannot be attributed to stages"
+                    )
+                stages: dict[str, float] = {}
+                for name in _COLD_STAGES:
+                    # provider_fetch lives under ensure_servable, not under
+                    # the runtime load span — search from the trace root
+                    sp = _find_span(root, name)
+                    if sp is not None:
+                        stages[name] = round(sp["duration_s"], 3)
+                rep_res = {
+                    "cold_first_s": cold_s,
+                    "ratio": load.get("attrs", {}).get("cold_overlap_ratio"),
+                    "stages": stages,
+                }
+                manager.close()
+                cur = best.get(arm)
+                if cur is None or cold_s < cur["cold_first_s"]:
+                    best[arm] = rep_res
+        for arm in ("serialized", "pipelined"):
+            fam[f"{arm}_cold_first_s"] = round(best[arm]["cold_first_s"], 3)
+            fam[f"{arm}_overlap_ratio"] = best[arm]["ratio"]
+            fam[f"{arm}_stage_s"] = best[arm]["stages"]
+        ser = fam["serialized_cold_first_s"]
+        pipe = fam["pipelined_cold_first_s"]
+        fam["speedup"] = round(ser / max(pipe, 1e-9), 3)
+        fam["pipelined_win_pct"] = round((1.0 - pipe / ser) * 100.0, 1)
+    return out
 
 
 def _tiny_draft_cfg(lm_config: dict) -> dict:
@@ -1241,8 +1494,17 @@ def bench_prefix_gen(tmp: str, lm_config: dict) -> dict:
     # prefills only the suffix. Together they bracket the workload
     # crossover instead of asserting one side.
     long_len = max(128, lm_config["max_seq"] // 2)
-    # history growth: turns * (completion + user tokens) must stay in-seq
-    assert long_len + turns * (max_new + 4) + max_new <= lm_config["max_seq"]
+    # history growth: turns * (completion + user tokens) must stay in-seq.
+    # Explicit raise (not assert): under python -O the long arm would sail
+    # past max_seq and report numbers for a silently truncated conversation.
+    budget_len = long_len + turns * (max_new + 4) + max_new
+    if budget_len > lm_config["max_seq"]:
+        raise ValueError(
+            f"prefix_gen long arm needs {budget_len} positions "
+            f"(opening {long_len} + {turns} turns x {max_new + 4} + final "
+            f"{max_new}) but the preset's max_seq is "
+            f"{lm_config['max_seq']}; shrink turns/max_new or raise max_seq"
+        )
     out = {"turns": turns, "max_new_tokens": max_new, "conversations": 3,
            "long_prompt_tokens": long_len}
     for label, use_draft, plen, seed0 in (
@@ -1340,7 +1602,7 @@ def collect_watcher_evidence() -> dict:
     keep_sections = (
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
-        "zoo_cold", "device_kind", "chips", "only",
+        "zoo_cold", "cold_pipeline", "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
         if not fn.endswith(".json") or fn.endswith(".partial.json"):
@@ -1622,6 +1884,17 @@ def run(args) -> dict:
         except Exception as e:  # noqa: BLE001
             detail["tenant_soak"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # LAST: this section calls jax.clear_caches() per arm, which would force
+    # recompiles under any later section's measured window
+    if want("cold_pipeline"):
+        try:
+            with _section("cold_pipeline"):
+                detail["cold_pipeline"] = bench_cold_pipeline(
+                    os.path.join(tmp, "coldpipe")
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["cold_pipeline"] = {"error": f"{type(e).__name__}: {e}"}
+
     _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):
         if fam in detail:
@@ -1755,13 +2028,22 @@ def main() -> int:
         if hs.startswith("tpu_watcher_evidence."):
             src = detail["tpu_watcher_evidence"][hs.split(".", 1)[1]]
         lm = src.get("transformer_lm", {})
+        # only measured metrics reach the headline: an --only run that
+        # skipped the QPS sections must read as absent, not as "0 qps"
+        # (which looks like a catastrophic regression in a quick scan)
+        qps_segs = [
+            f"{label} {lm[key]:.0f} qps"
+            for key, label in (("warm_rest_qps", "lm REST"),
+                               ("warm_grpc_qps", "gRPC"))
+            if isinstance(lm.get(key), (int, float))
+        ]
+        qps_bits = ("; " + " ".join(qps_segs)) if qps_segs else ""
         emit(
             {
                 "metric": (
                     f"cold_miss_load_to_first_predict_p50 (worst family: "
-                    f"{worst_fam}, {detail['platform']}; {fam_bits}; "
-                    f"lm REST {lm.get('warm_rest_qps', 0):.0f} qps "
-                    f"gRPC {lm.get('warm_grpc_qps', 0):.0f} qps)"
+                    f"{worst_fam}, {detail['platform']}; {fam_bits}"
+                    f"{qps_bits})"
                     f"{tag}"
                 ),
                 "value": round(p50, 4),

@@ -55,3 +55,24 @@ def test_hung_child_hits_timeout_with_diagnostic(tmp_path, monkeypatch):
     assert not ok
     assert "did not answer within" in diag
     assert 2.5 < dt < 30.0  # two bounded attempts, no 20-minute hang
+
+
+def test_cached_probe_memoizes_first_verdict(tmp_path, monkeypatch):
+    """cached_backend_answers probes ONCE per process: the verdict is fixed
+    at startup, so later calls — even after the (stubbed) backend starts
+    failing — return the memo without spawning another child."""
+    monkeypatch.setattr(backend_probe, "_memo", None)
+    healthy = _stub(tmp_path, "print('ok cpu 1')")
+    monkeypatch.setattr(backend_probe.sys, "executable", healthy)
+    ok1, diag1 = backend_probe.cached_backend_answers(timeout_s=30.0)
+    assert ok1 and diag1 == "ok cpu 1"
+
+    marks = tmp_path / "attempts"
+    failing = _stub(
+        tmp_path,
+        "open(r'%s', 'a').write('x')\nsys.exit(1)" % marks,
+    )
+    monkeypatch.setattr(backend_probe.sys, "executable", failing)
+    ok2, diag2 = backend_probe.cached_backend_answers(timeout_s=30.0)
+    assert (ok2, diag2) == (ok1, diag1)
+    assert not marks.exists()  # memo hit: no second child ever spawned

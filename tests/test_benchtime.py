@@ -68,3 +68,18 @@ def test_timed_inputs_never_repeat_warmup_inputs(monkeypatch, base):
     assert len(timed) >= 4  # at least one round of repeats * (1-iter + n-iter)
     assert all(t not in warmup_vals for t in timed)
     assert len(set(timed)) == len(timed)
+
+
+def test_return_valid_flag_shapes():
+    """return_valid=True yields (estimate, dominated); the default stays a
+    bare float so existing call sites are untouched."""
+    x = jnp.ones((64, 64), jnp.float32)
+
+    def f(x):
+        return jnp.tanh(x @ x)
+
+    est, dominated = chained_device_time(f, (x,), iters=8, return_valid=True)
+    assert isinstance(est, float) and est > 0
+    assert isinstance(dominated, bool)
+    plain = chained_device_time(f, (x,), iters=8)
+    assert isinstance(plain, float)

@@ -1,0 +1,252 @@
+"""Pipelined cold load: the pipeline must be a pure latency optimization.
+
+Serialized (``cold_load_pipeline=False``) and pipelined arms must land the
+SAME resident state and the SAME predict outputs for every zoo family, for
+quantized artifacts, and under a mesh runtime (where the pipeline
+deliberately disables itself — lockstep multi-host device-op streams must
+not see threaded transfers). A provider failure mid-stream must leave no
+partial resident entry and no jit-refcount drift.
+"""
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.base import ProviderError
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact, families
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.metrics import Metrics
+
+SMALL_LM = {
+    "vocab_size": 512, "d_model": 128, "n_layers": 2, "n_heads": 4,
+    "n_kv_heads": 2, "d_ff": 256, "max_seq": 128, "dtype": "bfloat16",
+}
+
+
+def _family_config(family):
+    if family == "bert":
+        from tfservingcache_tpu.models.bert import TINY_CONFIG
+        return TINY_CONFIG
+    if family == "resnet":
+        from tfservingcache_tpu.models.resnet import TINY_CONFIG
+        return TINY_CONFIG
+    if family == "t5":
+        from tfservingcache_tpu.models.t5 import TINY_CONFIG
+        return TINY_CONFIG
+    if family == "moe_lm":
+        return dict(SMALL_LM, n_experts=4, capacity_factor=2.0,
+                    aux_loss_weight=0.01)
+    if family == "transformer_lm":
+        return SMALL_LM
+    return None
+
+
+def _example_inputs(family, config, seed=7):
+    from tfservingcache_tpu.models.registry import build
+
+    model_def = build(family, config)
+    rng = np.random.default_rng(seed)
+    vocab = 8
+    if isinstance(model_def.config, dict):
+        vocab = int(model_def.config.get("vocab_size", 8) or 8)
+    out = {}
+    for name, spec in model_def.input_spec.items():
+        shape = tuple(
+            4 if isinstance(d, str) else d for d in spec.norm_shape()
+        )
+        if spec.np_dtype().kind in "iu":
+            hi = vocab if "ids" in name else 2
+            out[name] = rng.integers(0, hi, shape).astype(spec.np_dtype())
+        else:
+            out[name] = rng.normal(size=shape).astype(spec.np_dtype())
+    return out
+
+
+def _stack(tmp_path, store, label, pipeline, mesh=None, provider=None):
+    rt = TPUModelRuntime(
+        ServingConfig(cold_load_pipeline=pipeline), Metrics(), mesh=mesh
+    )
+    mgr = CacheManager(
+        provider or DiskModelProvider(store),
+        ModelDiskCache(str(tmp_path / f"cache-{label}"),
+                       capacity_bytes=1 << 30),
+        rt,
+    )
+    return mgr, rt
+
+
+def _run_arm(tmp_path, store, family, config, label, pipeline, mesh=None):
+    mgr, rt = _stack(tmp_path, store, label, pipeline, mesh=mesh)
+    try:
+        assert rt.cold_pipeline_enabled == (pipeline and mesh is None)
+        mid = ModelId("m", 1)
+        mgr.ensure_servable(mid)
+        assert rt.is_loaded(mid)
+        out = rt.predict(mid, _example_inputs(family, config))
+        arrays = {k: np.asarray(v) for k, v in out.items()}
+        loaded = rt._resident.get(mid)
+        jit_refs = {k: refs for k, (_, refs) in rt._jitted_by_key.items()}
+        return arrays, loaded, jit_refs
+    finally:
+        mgr.close()
+
+
+@pytest.mark.parametrize("family", sorted(families()))
+def test_pipeline_parity_all_families(tmp_path, family):
+    """Identical predict outputs and resident shape, serialized vs
+    pipelined, for every family in the zoo."""
+    config = _family_config(family)
+    store = str(tmp_path / "store")
+    export_artifact(family, store, name="m", version=1, config=config)
+
+    ser, ser_loaded, ser_refs = _run_arm(
+        tmp_path, store, family, config, "ser", pipeline=False
+    )
+    pipe, pipe_loaded, pipe_refs = _run_arm(
+        tmp_path, store, family, config, "pipe", pipeline=True
+    )
+    assert set(ser) == set(pipe)
+    for k in ser:
+        np.testing.assert_array_equal(ser[k], pipe[k], err_msg=k)
+    # resident state parity: same param tree, same dtypes/shapes, same
+    # jit-table refcounts
+    import jax
+
+    ser_leaves = jax.tree_util.tree_leaves(ser_loaded.params)
+    pipe_leaves = jax.tree_util.tree_leaves(pipe_loaded.params)
+    assert len(ser_leaves) == len(pipe_leaves)
+    for a, b in zip(ser_leaves, pipe_leaves):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    assert ser_refs == pipe_refs
+
+
+@pytest.mark.parametrize("quantize", ["int8", None])
+def test_pipeline_parity_quantized(tmp_path, quantize):
+    """The interleaved per-leaf device dequant in the pipelined transfer
+    must produce exactly what the serialized whole-tree dequant does."""
+    store = str(tmp_path / "store")
+    export_artifact("transformer_lm", store, name="m", version=1,
+                    config=SMALL_LM, quantize=quantize)
+    ser, ser_loaded, _ = _run_arm(
+        tmp_path, store, "transformer_lm", SMALL_LM, "ser", pipeline=False
+    )
+    pipe, pipe_loaded, _ = _run_arm(
+        tmp_path, store, "transformer_lm", SMALL_LM, "pipe", pipeline=True
+    )
+    for k in ser:
+        np.testing.assert_array_equal(ser[k], pipe[k], err_msg=k)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(ser_loaded.params),
+                    jax.tree_util.tree_leaves(pipe_loaded.params)):
+        assert a.dtype == b.dtype  # dequant restored orig_dtype both ways
+
+
+def test_mesh_runtime_forces_serialized_path(tmp_path):
+    """A mesh runtime must ignore cold_load_pipeline=True (its device-op
+    stream is lockstep across processes; threaded transfers would diverge)
+    — and still serve identical outputs to an explicit serialized mesh arm."""
+    from tfservingcache_tpu.parallel.mesh import make_mesh
+
+    store = str(tmp_path / "store")
+    export_artifact("transformer_lm", store, name="m", version=1,
+                    config=SMALL_LM)
+    on, on_loaded, _ = _run_arm(
+        tmp_path, store, "transformer_lm", SMALL_LM, "mesh-on",
+        pipeline=True, mesh=make_mesh({"model": 8}),
+    )
+    off, off_loaded, _ = _run_arm(
+        tmp_path, store, "transformer_lm", SMALL_LM, "mesh-off",
+        pipeline=False, mesh=make_mesh({"model": 8}),
+    )
+    for k in on:
+        np.testing.assert_array_equal(on[k], off[k], err_msg=k)
+
+
+class _MidStreamFailProvider(DiskModelProvider):
+    """Streams model.json (firing the precompile hint), then dies before
+    the params land — the worst-case ordering for the pipelined load: the
+    AOT compile is already in flight when the fetch fails."""
+
+    def load_model_streaming(self, name, version, dest_dir, on_file=None):
+        import os
+
+        src = self._find_src_path(name, version)
+        if on_file is not None:
+            on_file("model.json", os.path.join(src, "model.json"))
+        raise ProviderError("stream died mid-params")
+
+
+def test_midstream_failure_leaves_no_partial_state(tmp_path):
+    """Provider error after the metadata landed: no resident entry, no jit
+    refcount drift, and a later good fetch serves correctly (the orphaned
+    in-flight AOT compile must not corrupt the retry)."""
+    store = str(tmp_path / "store")
+    export_artifact("transformer_lm", store, name="m", version=1,
+                    config=SMALL_LM)
+    mid = ModelId("m", 1)
+
+    bad_mgr, bad_rt = _stack(
+        tmp_path, store, "bad", pipeline=True,
+        provider=_MidStreamFailProvider(store),
+    )
+    try:
+        assert bad_rt.cold_pipeline_enabled
+        with pytest.raises(Exception):
+            bad_mgr.ensure_servable(mid)
+        assert not bad_rt.is_loaded(mid)
+        assert bad_rt._resident.get(mid) is None
+        assert bad_rt._jitted_by_key == {}
+
+        # retry through a good provider against the SAME runtime: the
+        # in-flight/settled AOT future from the failed attempt must be
+        # either used or ignored, never wedge or corrupt the load
+        good_mgr = CacheManager(
+            DiskModelProvider(store),
+            ModelDiskCache(str(tmp_path / "cache-good"),
+                           capacity_bytes=1 << 30),
+            bad_rt,
+        )
+        try:
+            good_mgr.ensure_servable(mid)
+            assert bad_rt.is_loaded(mid)
+            out = bad_rt.predict(
+                mid, _example_inputs("transformer_lm", SMALL_LM)
+            )
+            assert all(np.isfinite(np.asarray(v)).all() for v in out.values())
+        finally:
+            good_mgr.close()
+    finally:
+        bad_mgr.close()
+
+
+def test_serialized_flag_is_exercised(tmp_path):
+    """cold_load_pipeline=False is the documented fallback: the runtime
+    must report the pipeline disabled and take the serialized path (no
+    transfer_sync span, no AOT cache entries)."""
+    from tfservingcache_tpu.utils.tracing import TRACER
+
+    store = str(tmp_path / "store")
+    export_artifact("transformer_lm", store, name="m", version=1,
+                    config=SMALL_LM)
+    mgr, rt = _stack(tmp_path, store, "flag", pipeline=False)
+    try:
+        assert not rt.cold_pipeline_enabled
+        TRACER.clear()
+        mgr.ensure_servable(ModelId("m", 1))
+        assert rt._aot_cache == {}
+
+        def names(span):
+            yield span["name"]
+            for c in span.get("children", []):
+                yield from names(c)
+
+        seen = [n for t in TRACER.recent(8) for n in names(t)]
+        assert "compile_warmup" in seen
+        assert "transfer_sync" not in seen
+    finally:
+        mgr.close()

@@ -18,6 +18,7 @@ the heart of the system. Differences by design:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -200,14 +201,47 @@ class CacheManager:
 
     def _fetch(self, model_id: ModelId) -> Model:
         """MISS path: size -> evict-to-fit -> provider fetch -> index.
-        Reference cachemanager.go:114-127 (minus its double-eviction quirk)."""
+        Reference cachemanager.go:114-127 (minus its double-eviction quirk).
+
+        With a pipelined runtime the fetch goes through the provider's
+        streaming variant: the moment model.json lands on disk its manifest
+        is handed to ``runtime.precompile_from_meta``, so the family's XLA
+        compile overlaps the rest of the download — the widest overlap the
+        cold pipeline gets, since provider fetch is usually its longest
+        stage."""
         t0 = time.monotonic()
+        on_file = None
+        if getattr(self.runtime, "cold_pipeline_enabled", False):
+            runtime = self.runtime
+
+            def on_file(rel: str, local_path: str) -> None:
+                if os.path.basename(rel) != "model.json":
+                    return
+                try:
+                    from tfservingcache_tpu.models.registry import (
+                        load_artifact_meta,
+                    )
+
+                    runtime.precompile_from_meta(load_artifact_meta(local_path))
+                except Exception as e:  # noqa: BLE001 - advisory hint only
+                    log.debug("early precompile for %s skipped: %s", model_id, e)
+
         with TRACER.span("provider_fetch", model=str(model_id)):
             size = self.provider.model_size(model_id.name, model_id.version)
             self.disk_cache.ensure_free_bytes(size)
-            model = self.provider.load_model(
-                model_id.name, model_id.version, self.disk_cache.model_path(model_id)
-            )
+            # duck-typed: fake providers that only implement load_model
+            # (tests, external plugins) keep working without the overlap
+            stream = getattr(self.provider, "load_model_streaming", None)
+            if on_file is not None and stream is not None:
+                model = stream(
+                    model_id.name, model_id.version,
+                    self.disk_cache.model_path(model_id), on_file=on_file,
+                )
+            else:
+                model = self.provider.load_model(
+                    model_id.name, model_id.version,
+                    self.disk_cache.model_path(model_id),
+                )
         self.disk_cache.put(model)
         if self.metrics is not None:
             self.metrics.cache_fetch_duration.labels(

@@ -60,10 +60,52 @@ class ModelNotFoundError(ProviderError):
     pass
 
 
+# Files a streaming fetch should land (and announce) FIRST: the artifact
+# metadata is enough for the runtime to start compiling the family
+# executable while the parameter bytes are still in flight.
+STREAM_META_FILES = ("model.json",)
+
+
+def _notify_file(on_file, rel: str, local_path: str) -> None:
+    """Invoke a streaming callback; callbacks are advisory and must never
+    break the fetch they ride on."""
+    if on_file is None:
+        return
+    try:
+        on_file(rel, local_path)
+    except Exception:  # noqa: BLE001 - advisory hook
+        import logging
+
+        logging.getLogger("tpusc.providers").debug(
+            "streaming on_file callback failed for %s", rel, exc_info=True
+        )
+
+
 class ModelProvider(abc.ABC):
     @abc.abstractmethod
     def load_model(self, name: str, version: int, dest_dir: str) -> Model:
         """Fetch ``<name>/<version>`` into ``dest_dir`` and return the Model."""
+
+    def load_model_streaming(
+        self, name: str, version: int, dest_dir: str, on_file=None
+    ) -> Model:
+        """Like ``load_model``, additionally invoking
+        ``on_file(rel_path, local_path)`` as each artifact file finishes
+        landing — metadata files (STREAM_META_FILES) as early as the backend
+        allows, so the pipelined cold load can overlap compilation with the
+        rest of the fetch. The callback may fire from fetch worker threads
+        and must be cheap; exceptions from it are swallowed.
+
+        This default fetches fully and only then fires the callbacks (no
+        overlap, but identical semantics) — providers that can genuinely
+        stream override it."""
+        model = self.load_model(name, version, dest_dir)
+        if on_file is not None:
+            for root, _dirs, files in os.walk(model.path):
+                for fn in sorted(files, key=lambda f: f not in STREAM_META_FILES):
+                    full = os.path.join(root, fn)
+                    _notify_file(on_file, os.path.relpath(full, model.path), full)
+        return model
 
     @abc.abstractmethod
     def model_size(self, name: str, version: int) -> int:

@@ -14,9 +14,11 @@ import shutil
 
 from tfservingcache_tpu.cache.disk_cache import dir_size_bytes
 from tfservingcache_tpu.cache.providers.base import (
+    STREAM_META_FILES,
     ModelNotFoundError,
     ModelProvider,
     ProviderError,
+    _notify_file,
     atomic_dest,
 )
 from tfservingcache_tpu.types import Model, ModelId
@@ -47,6 +49,34 @@ class DiskModelProvider(ModelProvider):
         src = self._find_src_path(name, version)
         with atomic_dest(dest_dir) as tmp:
             shutil.copytree(src, tmp)
+        return Model(
+            identifier=ModelId(name, version),
+            path=dest_dir,
+            size_on_disk=dir_size_bytes(dest_dir),
+        )
+
+    def load_model_streaming(
+        self, name: str, version: int, dest_dir: str, on_file=None
+    ) -> Model:
+        """File-by-file copy, metadata first, announcing each file as it
+        lands — model.json reaches the runtime's precompile hook while
+        params.bin is still copying. Same atomic-staging discipline as
+        ``load_model``; without a callback that simpler path is used."""
+        if on_file is None:
+            return self.load_model(name, version, dest_dir)
+        src = self._find_src_path(name, version)
+        with atomic_dest(dest_dir) as tmp:
+            rels = []
+            for root, _dirs, files in os.walk(src):
+                for fn in files:
+                    full = os.path.join(root, fn)
+                    rels.append(os.path.relpath(full, src))
+            rels.sort(key=lambda r: (os.path.basename(r) not in STREAM_META_FILES, r))
+            for rel in rels:
+                local = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(local), exist_ok=True)
+                shutil.copy2(os.path.join(src, rel), local)
+                _notify_file(on_file, rel, local)
         return Model(
             identifier=ModelId(name, version),
             path=dest_dir,

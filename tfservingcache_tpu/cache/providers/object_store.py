@@ -29,9 +29,11 @@ from dataclasses import dataclass
 from typing import Callable, Iterator
 
 from tfservingcache_tpu.cache.providers.base import (
+    STREAM_META_FILES,
     ModelNotFoundError,
     ModelProvider,
     ProviderError,
+    _notify_file,
     atomic_dest,
 )
 from tfservingcache_tpu.types import Model, ModelId
@@ -189,6 +191,19 @@ class ObjectStoreProvider(ModelProvider):
 
     # -- ModelProvider interface --------------------------------------------
     def load_model(self, name: str, version: int, dest_dir: str) -> Model:
+        return self._load(name, version, dest_dir, None)
+
+    def load_model_streaming(
+        self, name: str, version: int, dest_dir: str, on_file=None
+    ) -> Model:
+        """Concurrent fetch with metadata objects submitted first and
+        ``on_file`` fired per landed object (from this calling thread, in
+        completion order) — model.json typically completes while params.bin
+        is still streaming, which is the fetch/compile overlap the
+        pipelined cold load feeds on."""
+        return self._load(name, version, dest_dir, on_file)
+
+    def _load(self, name: str, version: int, dest_dir: str, on_file) -> Model:
         """Fetch every object of the artifact, CONCURRENTLY (the reference
         downloads sequentially, s3modelprovider.go:124-159 — per-object
         round-trip latency then dominates a many-file artifact; a bounded
@@ -198,18 +213,22 @@ class ObjectStoreProvider(ModelProvider):
         objects, prefix = self._list_model_objects(name, version)
         total = 0
         with atomic_dest(dest_dir) as tmp:
-            work: list[tuple[ObjectInfo, str]] = []
+            work: list[tuple[ObjectInfo, str, str]] = []
             for obj in objects:
                 rel = obj.key[len(prefix):]
                 if not rel or rel.endswith("/"):
                     continue  # zero-byte "directory" placeholder objects
                 local = os.path.join(tmp, *rel.split("/"))
                 os.makedirs(os.path.dirname(local), exist_ok=True)
-                work.append((obj, local))
+                work.append((obj, local, rel))
+            # metadata first: with a streaming consumer the precompile hint
+            # should leave as early as the store allows (harmless otherwise)
+            work.sort(key=lambda w: w[2].rsplit("/", 1)[-1] not in STREAM_META_FILES)
             if len(work) <= 1:
-                for obj, local in work:
+                for obj, local, rel in work:
                     self._download(obj.key, local)
                     total += obj.size
+                    _notify_file(on_file, rel, local)
             else:
                 from concurrent.futures import ThreadPoolExecutor, as_completed
 
@@ -228,13 +247,15 @@ class ObjectStoreProvider(ModelProvider):
                 )
                 try:
                     futures = {
-                        pool.submit(self._download, obj.key, local): obj
-                        for obj, local in work
+                        pool.submit(self._download, obj.key, local): (obj, local, rel)
+                        for obj, local, rel in work
                     }
                     for f in as_completed(futures):
                         try:
                             f.result()
-                            total += futures[f].size
+                            obj, local, rel = futures[f]
+                            total += obj.size
+                            _notify_file(on_file, rel, local)
                         except Exception as e:  # noqa: BLE001
                             # fail fast: a multi-GB artifact must not keep
                             # streaming its other objects (egress + the cold

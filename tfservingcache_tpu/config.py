@@ -56,6 +56,17 @@ class ServingConfig:
     # of device memory for reusable prompt-prefix K/V. 0 = off (default —
     # entries hold real HBM). Single-group runtimes only; B=1 requests.
     prefix_cache_bytes: int = 0
+    # Pipelined cold load (runtime/model_runtime.py): AOT-compile the family
+    # executable concurrently with the params transfer, double-buffer the
+    # packed H2D chunks, and dequantize leaves as they land, so cold
+    # wall-clock ≈ max(stage) instead of Σ(stages). False restores the
+    # strictly serialized stage-after-stage path (identical results, one
+    # flag away). Mesh/multi-process runtimes always run serialized — the
+    # lockstep device-op stream must not depend on host thread timing.
+    cold_load_pipeline: bool = True
+    # Host buffers the chunk assembler may run ahead of the H2D stream
+    # (bounded queue depth; each slot holds up to one ~256 MB packed chunk).
+    cold_pipeline_buffer_depth: int = 2
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

@@ -463,6 +463,65 @@ def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
     return model, _restore_lists(nested)
 
 
+def load_artifact_meta(path: str) -> dict[str, Any]:
+    """Parse an artifact's ``model.json`` alone — no params bytes touched.
+
+    ``path`` may be the artifact directory or the model.json file itself
+    (the streaming fetch hands over the staged metadata file while
+    params.bin is still in flight). Raises ArtifactError on malformed or
+    non-v2 metadata; callers that only want the pipeline hint treat any
+    raise as "precompile not possible"."""
+    meta_path = path
+    if os.path.isdir(path):
+        meta_path = os.path.join(path, MODEL_JSON)
+    if not os.path.exists(meta_path):
+        raise ArtifactError(f"no {MODEL_JSON} at {path}")
+    with open(meta_path) as f:
+        try:
+            meta = json.load(f)
+        except ValueError as e:
+            raise ArtifactError(f"unparseable {meta_path}: {e}") from e
+    if not isinstance(meta, dict) or "family" not in meta:
+        raise ArtifactError(f"malformed artifact metadata in {meta_path}")
+    return meta
+
+
+def abstract_params_from_meta(meta: Mapping[str, Any]) -> Any:
+    """The POST-dequant params pytree as ``jax.ShapeDtypeStruct`` leaves,
+    reconstructed from a v2 manifest alone (None when the format carries no
+    manifest, i.e. v1 msgpack).
+
+    This is what makes compile-while-transfer possible: the manifest names
+    every leaf's path, shape and (for int8 entries) original float dtype, so
+    ``jax.jit(apply).lower(...)`` can run before a single parameter byte has
+    landed on the host. The tree structure must match ``load_artifact``'s
+    exactly (same nesting, same list restoration) or the AOT executable
+    would be traced against a different treedef than the real params."""
+    import jax
+
+    import ml_dtypes  # registers bfloat16/float8 names with np.dtype
+
+    del ml_dtypes
+    if meta.get("format") != ARTIFACT_FORMAT:
+        return None
+    manifest = (meta.get("params") or {}).get("manifest")
+    if manifest is None:
+        return None
+    nested: dict[str, Any] = {}
+    for ent in manifest:
+        quant = ent.get("quant")
+        dt = np.dtype(quant["orig_dtype"] if quant else ent["dtype"])
+        leaf = jax.ShapeDtypeStruct(tuple(ent["shape"]), dt)
+        if ent["path"] == "":
+            return leaf  # params was a single bare array
+        node = nested
+        parts = ent["path"].split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = leaf
+    return _restore_lists(nested)
+
+
 def resident_bytes_estimate(path: str) -> int | None:
     """Estimated DEVICE bytes of the artifact's params once servable (None
     if unreadable). For plain artifacts this matches the on-disk param bytes;

@@ -109,6 +109,18 @@ class BaseRuntime(abc.ABC):
         without a decoder path keep this default."""
         raise RuntimeError_("this runtime does not support generation")
 
+    # Does this runtime overlap cold-load stages? CacheManager consults this
+    # (duck-typed via getattr) to decide whether a streaming provider fetch
+    # is worth wiring up; the base default keeps fakes and CPU-only runtimes
+    # on the plain fetch path.
+    cold_pipeline_enabled: bool = False
+
+    def precompile_from_meta(self, meta) -> None:
+        """Advisory hint: artifact metadata is available (the provider fetch
+        may still be streaming params bytes) — a pipelined runtime starts
+        AOT-compiling the family executable now. Must never raise into the
+        fetch path; the default does nothing."""
+
     @abc.abstractmethod
     def check(self) -> None:
         """Raise when the runtime/accelerator is unhealthy."""

@@ -14,7 +14,40 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import threading
 import time
+
+# per-process memo for cached_backend_answers(); None = never probed
+_memo: tuple[bool, str] | None = None
+_memo_lock = threading.Lock()
+
+
+def cached_backend_answers(
+    timeout_s: float = 90.0, retries: int = 0, backoff_s: float = 5.0
+) -> tuple[bool, str]:
+    """``backend_answers`` with the verdict memoized per process.
+
+    A process that probes more than once (driver entry retries, several
+    subsystems each deciding CPU-vs-TPU) would otherwise pay the full
+    child-process spin-up — worst case ~90 s per probe, and with the default
+    retry schedule nearly 5 minutes — every time, for an answer that does
+    not change within a process's lifetime: the backend env is fixed at
+    startup and a mid-process tunnel recovery can't be used anyway once
+    callers have pinned CPU. First call wins; later calls (any arguments)
+    return the memoized verdict. Defaults to ``retries=0``: the memo makes
+    the verdict permanent, so burning minutes of backoff to avoid
+    memoizing a blip is a worse trade than one bounded attempt.
+
+    ``backend_answers`` itself stays uncached for callers (and tests) that
+    need a fresh probe.
+    """
+    global _memo
+    with _memo_lock:
+        if _memo is None:
+            _memo = backend_answers(
+                timeout_s=timeout_s, retries=retries, backoff_s=backoff_s
+            )
+        return _memo
 
 
 def backend_answers(

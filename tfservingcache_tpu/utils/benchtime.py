@@ -23,7 +23,8 @@ def chained_device_time(
     iters: int = 16,
     repeats: int = 3,
     max_iters: int = 1024,
-) -> float:
+    return_valid: bool = False,
+) -> float | tuple[float, bool]:
     """Seconds per call of ``fn(*args)`` measured on device.
 
     ``fn`` must be traceable and return an array (or pytree; the first leaf
@@ -45,7 +46,10 @@ def chained_device_time(
     accordingly for very cheap ``fn``: worst case ~4 extra compiles and a
     ``max_iters``-long chain per call. If dominance is never reached even at
     ``max_iters``, the (noisy) max_iters estimate is returned rather than
-    failing.
+    failing — callers that publish the number should pass
+    ``return_valid=True`` to get ``(estimate, dominated)`` back and mark the
+    row noisy when ``dominated`` is False, instead of printing dispatch
+    noise as if it were kernel time.
     """
     import jax
     import jax.numpy as jnp
@@ -97,11 +101,13 @@ def chained_device_time(
     # "0.000 ms" (the r5 kernel-check small-shape artifact). Grow the chain
     # until the long run clearly dominates the short one, so the subtraction
     # carries signal, not noise.
+    dominated = False
     while True:
         pairs = measure(iters)
         shorts = sorted(s for s, _ in pairs)
         longs = sorted(l for _, l in pairs)
         if longs[len(longs) // 2] >= 2.0 * shorts[len(shorts) // 2]:
+            dominated = True
             break
         if iters >= max_iters:
             break
@@ -109,4 +115,7 @@ def chained_device_time(
     estimates = sorted(
         max(l - s, 1e-9) / (iters - 1) for s, l in pairs
     )
-    return estimates[len(estimates) // 2]
+    est = estimates[len(estimates) // 2]
+    if return_valid:
+        return est, dominated
+    return est

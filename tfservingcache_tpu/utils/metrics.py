@@ -132,6 +132,16 @@ class Metrics:
             ["stage"], registry=r,
             buckets=(.005, .02, .05, .1, .25, .5, 1, 2, 5, 10, 30),
         )
+        self.cold_overlap_ratio = Histogram(
+            "tpusc_cold_overlap_ratio",
+            "Σ(per-stage seconds)/wall seconds per runtime load: ~1.0 means "
+            "the stages ran strictly back-to-back (serialized path), >1 "
+            "means the pipelined cold load overlapped them (AOT compile and "
+            "per-leaf dequant running during the transfer) — the higher, "
+            "the more of the compile the transfer hid",
+            registry=r,
+            buckets=(0.8, 0.95, 1.0, 1.1, 1.2, 1.35, 1.5, 1.75, 2.0, 2.5, 3.0, 4.0),
+        )
         self.group_reforms = Counter(
             "tpusc_group_reform_events_total",
             "Cross-host group failure-containment events",

@@ -88,6 +88,20 @@ class Tracer:
         if sp is not None:
             sp.attrs.update(attrs)
 
+    def attach(self, parent: Span, name: str, duration_s: float,
+               start_s: float | None = None, **attrs: Any) -> Span:
+        """Attach an externally-timed, already-completed span as a child of
+        ``parent``. For work that ran on an executor thread whose ambient
+        context predates ``parent`` (the pipelined cold load's AOT compile),
+        ``span()`` can't parent it — and for overlapped work Σ(children) may
+        legitimately exceed the parent's wall time, which is exactly what
+        ``cold_overlap_ratio`` measures."""
+        sp = Span(name=name, attrs=attrs,
+                  start_s=time.time() if start_s is None else start_s,
+                  duration_s=duration_s)
+        parent.children.append(sp)
+        return sp
+
     def recent(self, n: int = 50) -> list[dict[str, Any]]:
         with self._lock:
             return [s.to_dict() for s in self._traces[-n:]][::-1]
