@@ -34,13 +34,15 @@ from tfservingcache_tpu.protocol.grpc_server import (
     MODEL_SERVICE,
     PREDICTION_SERVICE,
     SESSION_SERVICE,
+    TRACE_SUBTREE_TRAILER,
     GrpcServingServer,
 )
-from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.protocol.rest import TRACE_SUBTREE_HEADER, RestServingServer
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
 from tfservingcache_tpu.types import ModelId, NodeInfo
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.net import outbound_ip
+from tfservingcache_tpu.utils.tracing import TRACER, format_traceparent
 
 log = get_logger("router")
 
@@ -152,22 +154,52 @@ class RoutingBackend(ServingBackend):
                     (MODEL_SERVICE, "GetModelStatus"): local.get_model_status,
                     (SESSION_SERVICE, "SessionRun"): local.session_run,
                 }[(service, method)]
+                TRACER.annotate_root(route="local")
                 return await fn(request)
-            try:
-                stub = await self.pool.stub(node)
-                return await stub.method(service, method)(request)
-            except grpc.aio.AioRpcError as e:
-                if e.code() in (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED):
-                    # connection-level failure: try the next replica
-                    last_err = e
-                    log.warning(
-                        "peer %s unavailable for %s/%s (attempt %d): %s",
-                        node.ident, service, method, attempt + 1, e.details(),
+            # one route span per forwarding attempt; the peer adopts our
+            # traceparent and ships its finished subtree back on the trailer
+            with TRACER.span(
+                "route", peer=node.ident, protocol="grpc", method=method
+            ) as route_sp:
+                TRACER.annotate_root(route="forwarded")
+                call = None
+                try:
+                    stub = await self.pool.stub(node)
+                    tp = format_traceparent(route_sp)
+                    call = stub.method(service, method)(
+                        request, metadata=(("traceparent", tp),) if tp else None
                     )
-                    continue
-                raise
+                    resp = await call
+                    await self._stitch_grpc(call, route_sp, node)
+                    return resp
+                except grpc.aio.AioRpcError as e:
+                    await self._stitch_grpc(call, route_sp, node)
+                    if e.code() in (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED):
+                        # connection-level failure: try the next replica
+                        last_err = e
+                        log.warning(
+                            "peer %s unavailable for %s/%s (attempt %d): %s",
+                            node.ident, service, method, attempt + 1, e.details(),
+                        )
+                        continue
+                    raise
         assert last_err is not None
         raise last_err
+
+    @staticmethod
+    async def _stitch_grpc(call, route_sp, node: NodeInfo) -> None:
+        """Graft the peer's trace subtree (trailing metadata) under the route
+        span; best-effort — stitching must never fail the request."""
+        if call is None:
+            return
+        try:
+            trailers = await call.trailing_metadata()
+        except Exception:  # noqa: BLE001 — dead channel: no trailers to read
+            return
+        for key, value in trailers or ():
+            if key == TRACE_SUBTREE_TRAILER:
+                TRACER.attach_remote(route_sp, value, peer=node.ident)
+                return
 
     # -- ServingBackend (gRPC shapes) ---------------------------------------
     async def predict(self, request: sv.PredictRequest) -> sv.PredictResponse:
@@ -231,6 +263,7 @@ class RoutingBackend(ServingBackend):
         for node in self._candidates(model_name, version)[: self.retries + 1]:
             local = self.local_backends.get(node.ident)
             if local is not None:
+                TRACER.annotate_root(route="local")
                 return await local.handle_rest(method, model_name, version, verb, body)
             url = f"http://{node.host}:{node.rest_port}/v1/models/{model_name}"
             if version is not None:
@@ -239,20 +272,33 @@ class RoutingBackend(ServingBackend):
                 url += "/metadata"
             elif verb is not None:
                 url += f":{verb}"
-            try:
-                async with self._http_session().request(
-                    method, url, data=body or None
-                ) as resp:
-                    payload = await resp.read()
-                    return RestResponse(
-                        status=resp.status,
-                        body=payload,
-                        content_type=resp.content_type or "application/json",
-                    )
-            except aiohttp.ClientConnectionError as e:
-                last_err = e
-                log.warning("peer %s unreachable for REST %s: %s", node.ident, url, e)
-                continue
+            # one route span per forwarding attempt; the peer adopts our
+            # traceparent and returns its finished subtree on a header
+            with TRACER.span(
+                "route", peer=node.ident, protocol="rest", verb=verb or "status"
+            ) as route_sp:
+                TRACER.annotate_root(route="forwarded")
+                headers = {}
+                tp = format_traceparent(route_sp)
+                if tp:
+                    headers["traceparent"] = tp
+                try:
+                    async with self._http_session().request(
+                        method, url, data=body or None, headers=headers
+                    ) as resp:
+                        payload = await resp.read()
+                        subtree = resp.headers.get(TRACE_SUBTREE_HEADER)
+                        if subtree:
+                            TRACER.attach_remote(route_sp, subtree, peer=node.ident)
+                        return RestResponse(
+                            status=resp.status,
+                            body=payload,
+                            content_type=resp.content_type or "application/json",
+                        )
+                except aiohttp.ClientConnectionError as e:
+                    last_err = e
+                    log.warning("peer %s unreachable for REST %s: %s", node.ident, url, e)
+                    continue
         raise BackendError(
             f"all replicas unreachable: {last_err}", grpc.StatusCode.UNAVAILABLE, 503
         )

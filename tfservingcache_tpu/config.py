@@ -169,6 +169,18 @@ class MetricsConfig:
 
 
 @dataclass
+class TracingConfig:
+    """Always-on request tracing (utils/tracing.py; no reference
+    counterpart — SURVEY.md §5 "no OpenTelemetry/pprof anywhere")."""
+
+    capacity: int = 256                # completed traces kept in the ring
+    # tail sampling: traces slower than this survive in a separate bounded
+    # buffer even after fast traffic wraps the main ring; 0 disables
+    slow_threshold_ms: float = 1000.0
+    slow_capacity: int = 64
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     fmt: str = "text"                  # text | json (reference cfg.go:28-61)
@@ -184,6 +196,7 @@ class Config:
     discovery: DiscoveryConfig = field(default_factory=DiscoveryConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     # health probe model name (reference cfg.go:64-66 default)
     health_probe_model: str = "__TPUSC_PROBE_CHECK__"

@@ -12,6 +12,7 @@ process, which no longer exists.
 from __future__ import annotations
 
 import asyncio
+import time
 
 import grpc
 
@@ -20,7 +21,16 @@ from tfservingcache_tpu.protocol.protos import grpc_health_pb2 as health_pb
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
-from tfservingcache_tpu.utils.tracing import TRACER
+from tfservingcache_tpu.utils.tracing import (
+    TRACER,
+    parse_traceparent,
+    remote_parent,
+    serialize_span,
+)
+
+# trailing-metadata key carrying this node's completed span subtree back to
+# the router (ASCII-safe base64, so no -bin suffix needed)
+TRACE_SUBTREE_TRAILER = "tpusc-trace"
 
 log = get_logger("grpc")
 
@@ -72,6 +82,26 @@ class HealthState:
         await self._event.wait()
 
 
+class _UnknownMethodHandler(grpc.GenericRpcHandler):
+    """Fallback generic handler: any RPC no earlier handler claimed (unknown
+    method on a known service, or an unknown service entirely) is answered
+    UNIMPLEMENTED *through our counting path* instead of by the gRPC runtime,
+    so requests/failures{protocol="grpc"} cover the same population as REST
+    (which counts unparseable URLs). Health stays exempt on both sides."""
+
+    def __init__(self, unknown_method) -> None:
+        self._handler = grpc.unary_unary_rpc_method_handler(
+            unknown_method,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b if isinstance(b, bytes) else b"",
+        )
+
+    def service(self, handler_call_details):
+        if handler_call_details.method.startswith(f"/{HEALTH_SERVICE}/"):
+            return None
+        return self._handler
+
+
 class GrpcServingServer:
     def __init__(
         self,
@@ -88,32 +118,71 @@ class GrpcServingServer:
 
     # -- handler plumbing ---------------------------------------------------
     def _unary(self, fn, req_cls, resp_cls):
+        verb = fn.__name__.lower().lstrip("_")  # predict / classify / ...
+
         async def handler(request, context: grpc.aio.ServicerContext):
             if self.metrics is not None:
                 self.metrics.request_count.labels("grpc").inc()
+                self.metrics.requests_in_flight.labels("grpc").inc()
+            t0 = time.monotonic()
+            # inbound W3C context from a routing peer (plain metadata key)
+            remote_ctx = None
+            for key, value in context.invocation_metadata() or ():
+                if key == "traceparent":
+                    remote_ctx = parse_traceparent(value)
+                    break
+            sp = None
+            err: tuple[grpc.StatusCode, str] | None = None
+            resp = None
             try:
-                with TRACER.span("grpc", method=fn.__name__):
-                    return await fn(request)
+                with remote_parent(remote_ctx), \
+                        TRACER.span("grpc", method=fn.__name__) as sp:
+                    resp = await fn(request)
             except BackendError as e:
-                if self.metrics is not None:
-                    self.metrics.request_failures.labels("grpc").inc()
-                await context.abort(e.grpc_code or grpc.StatusCode.INTERNAL, str(e))
+                err = (e.grpc_code or grpc.StatusCode.INTERNAL, str(e))
             except grpc.aio.AioRpcError as e:
                 # peer-forwarding failure: surface the upstream code verbatim
-                if self.metrics is not None:
-                    self.metrics.request_failures.labels("grpc").inc()
-                await context.abort(e.code(), e.details() or "upstream error")
+                err = (e.code(), e.details() or "upstream error")
             except Exception as e:  # noqa: BLE001
-                if self.metrics is not None:
-                    self.metrics.request_failures.labels("grpc").inc()
                 log.exception("unhandled error in %s", fn.__name__)
-                await context.abort(grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+                err = (grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+            finally:
+                if self.metrics is not None:
+                    self.metrics.requests_in_flight.labels("grpc").dec()
+                    if err is not None:
+                        self.metrics.request_failures.labels("grpc").inc()
+                    route = (sp.attrs.get("route") if sp is not None else None) or "local"
+                    self.metrics.request_duration.labels(
+                        "grpc", verb, "ok" if err is None else "error", route
+                    ).observe(time.monotonic() - t0)
+            if remote_ctx is not None and sp is not None:
+                # routed hop: return our completed subtree on the trailer so
+                # the router can stitch it (also reaches the client on abort)
+                context.set_trailing_metadata(
+                    ((TRACE_SUBTREE_TRAILER, serialize_span(sp)),)
+                )
+            if err is not None:
+                await context.abort(err[0], err[1])
+            return resp
 
         return grpc.unary_unary_rpc_method_handler(
             handler,
             request_deserializer=req_cls.FromString,
             response_serializer=resp_cls.SerializeToString,
         )
+
+    async def _unknown_method(self, request, context: grpc.aio.ServicerContext):
+        """Catch-all for unknown services/methods, so the gRPC counters see
+        the same request population REST does (REST counts every model-API
+        hit, parseable or not; stock gRPC would answer UNIMPLEMENTED before
+        any counter fired)."""
+        if self.metrics is not None:
+            self.metrics.request_count.labels("grpc").inc()
+            self.metrics.request_failures.labels("grpc").inc()
+            self.metrics.request_duration.labels(
+                "grpc", "invalid", "error", "local"
+            ).observe(0.0)
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "unknown method")
 
     async def _multi_inference(self, request):
         # Parity with the reference: MultiInference is rejected
@@ -158,10 +227,14 @@ class GrpcServingServer:
                 response_serializer=health_pb.HealthCheckResponse.SerializeToString,
             ),
         }
-        return [
+        handlers: list[grpc.GenericRpcHandler] = [
             grpc.method_handlers_generic_handler(service, methods)
             for service, methods in per_service.items()
         ]
+        # registered LAST: catches calls to unknown methods/services (health
+        # excluded, matching REST's uncounted /healthz) for counter parity
+        handlers.append(_UnknownMethodHandler(self._unknown_method))
+        return handlers
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self, port: int, host: str = "0.0.0.0") -> int:

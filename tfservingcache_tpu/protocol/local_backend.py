@@ -37,6 +37,7 @@ from tfservingcache_tpu.runtime.base import (
 )
 from tfservingcache_tpu.types import ModelId, ModelState
 from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("local_backend")
 
@@ -152,7 +153,11 @@ class LocalServingBackend(ServingBackend):
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 412) from e
         except (KeyError, ModelNotFoundError) as e:
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
-        return ModelId(spec.name, version)
+        model_id = ModelId(spec.name, version)
+        # stamp the request's root span: the trace view and the SLO histogram
+        # both want "which model, served where" without walking children
+        TRACER.annotate_root(model=str(model_id), route="local")
+        return model_id
 
     def _predict_sync(
         self,
@@ -468,6 +473,7 @@ class LocalServingBackend(ServingBackend):
         except (KeyError, ModelNotFoundError) as e:
             raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
         model_id = ModelId(model_name, resolved)
+        TRACER.annotate_root(model=str(model_id), route="local")
 
         if method == "GET" and verb is None:
             return await self._rest_status(model_id)

@@ -23,13 +23,23 @@ import json
 import os
 import re
 import threading
+import time
 
 from aiohttp import web
 
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
-from tfservingcache_tpu.utils.tracing import TRACER
+from tfservingcache_tpu.utils.tracing import (
+    TRACER,
+    parse_traceparent,
+    remote_parent,
+    serialize_span,
+)
+
+# response header carrying this node's completed span subtree back to the
+# router that forwarded the request (see utils/tracing.serialize_span)
+TRACE_SUBTREE_HEADER = "x-tpusc-trace"
 
 log = get_logger("rest")
 
@@ -126,56 +136,102 @@ class RestServingServer:
         if path == "/monitoring/traces":
             try:
                 n = int(request.query.get("n", "50"))
+                min_ms = (
+                    float(request.query["min_ms"])
+                    if "min_ms" in request.query else None
+                )
             except ValueError:
-                return web.json_response({"error": "n must be an integer"}, status=400)
+                return web.json_response(
+                    {"error": "n must be an integer and min_ms a number"}, status=400
+                )
             # n<=0 means "none", not "everything" (negative slices would
             # truncate from the wrong end of the ring buffer)
-            return web.json_response({"traces": TRACER.recent(n) if n > 0 else []})
+            traces = TRACER.query(
+                n=n,
+                min_duration_s=min_ms / 1000.0 if min_ms is not None else None,
+                trace_id=request.query.get("trace_id"),
+            ) if n > 0 else []
+            return web.json_response({"traces": traces})
         if path == "/monitoring/profiler" and request.method == "POST":
             return await self._capture_profile(request)
 
+        # model-API surface from here down: counted, timed, in-flight-gauged
         if self.metrics is not None:
             self.metrics.request_count.labels("rest").inc()
+            self.metrics.requests_in_flight.labels("rest").inc()
+        t0 = time.monotonic()
+        response: web.Response | None = None
+        sp = None
+        verb_label = "invalid"
+        try:
+            response, sp, verb_label = await self._serve_model_request(request, path)
+            return response
+        finally:
+            if self.metrics is not None:
+                self.metrics.requests_in_flight.labels("rest").dec()
+                outcome = "ok" if response is not None and response.status < 400 else "error"
+                route = (sp.attrs.get("route") if sp is not None else None) or "local"
+                self.metrics.request_duration.labels(
+                    "rest", verb_label, outcome, route
+                ).observe(time.monotonic() - t0)
 
+    async def _serve_model_request(
+        self, request: web.Request, path: str
+    ) -> tuple[web.Response, object | None, str]:
+        """-> (response, completed root span | None, verb label). The span is
+        the request's root; its ``route`` attr (annotated by the backend) and
+        duration feed the SLO histogram in the dispatcher above."""
         parsed = parse_model_url(path)
         if parsed is None:
             return self._fail(web.Response(
                 status=404, body=_error_body("Not found"), content_type="application/json"
-            ))
+            )), None, "invalid"
         name, version, verb, label = parsed
+        verb_label = verb or ("status" if request.method == "GET" else "invalid")
         if version is None and label is None and self.require_version:
             return self._fail(web.Response(
                 status=400,
                 body=_error_body("Model version must be provided"),
                 content_type="application/json",
-            ))
+            )), None, verb_label
         body = await request.read()
+        # inbound W3C context (router hop): the root span joins the caller's
+        # trace instead of starting a fresh one
+        remote_ctx = parse_traceparent(request.headers.get("traceparent"))
+        sp = None
         try:
-            with TRACER.span("rest", path=path, method=request.method):
+            with remote_parent(remote_ctx), \
+                    TRACER.span("rest", path=path, method=request.method) as sp:
                 resp: RestResponse = await self.backend.handle_rest(
                     request.method, name, version, verb, body, label=label
                 )
         except BackendError as e:
-            return self._fail(web.Response(
+            response = self._fail(web.Response(
                 status=e.http_status,
                 body=json.dumps({"error": str(e)}).encode(),
                 content_type="application/json",
             ))
         except Exception as e:  # noqa: BLE001
             log.exception("unhandled REST error for %s", path)
-            return self._fail(web.Response(
+            response = self._fail(web.Response(
                 status=500,
                 body=json.dumps({"error": f"{type(e).__name__}: {e}"}).encode(),
                 content_type="application/json",
             ))
-        if resp.status >= 400 and self.metrics is not None:
-            self.metrics.request_failures.labels("rest").inc()
-        return web.Response(
-            status=resp.status,
-            body=resp.body,
-            content_type=resp.content_type,
-            headers=resp.headers,
-        )
+        else:
+            if resp.status >= 400 and self.metrics is not None:
+                self.metrics.request_failures.labels("rest").inc()
+            response = web.Response(
+                status=resp.status,
+                body=resp.body,
+                content_type=resp.content_type,
+                headers=resp.headers,
+            )
+        if remote_ctx is not None and sp is not None:
+            # the caller is a router stitching a distributed trace: ship our
+            # completed subtree back inline (span closed above, duration set)
+            response.headers[TRACE_SUBTREE_HEADER] = serialize_span(sp)
+        return response, sp, verb_label
 
     async def _capture_profile(self, request: web.Request) -> web.Response:
         """Capture a JAX/XLA device profile for ``duration_s`` into ``dir``

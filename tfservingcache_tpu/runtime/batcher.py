@@ -251,6 +251,8 @@ class MicroBatcher:
             if pend.rows >= self.max_batch:
                 pend.closed = True
                 self._pending.pop(key, None)
+        if self.metrics is not None:
+            self.metrics.batcher_queue_depth.labels("predict").inc()
 
         if not leader:
             if not slot.done.wait(self.wait_timeout_s):
@@ -271,6 +273,10 @@ class MicroBatcher:
                     pend.closed = True
                     self._pending.pop(key, None)
             slots = pend.slots
+            # the batch leaves the queue for the device the moment its leader
+            # holds the gate — success or failure, these are no longer queued
+            if self.metrics is not None:
+                self.metrics.batcher_queue_depth.labels("predict").dec(len(slots))
             try:
                 if len(slots) == 1:
                     out = self.runtime.predict(model_id, slot.inputs, output_filter)
@@ -456,6 +462,8 @@ class GenerateCoalescer:
             if pend.rows >= self.max_batch:
                 pend.closed = True
                 self._pending.pop(key, None)
+        if self.metrics is not None:
+            self.metrics.batcher_queue_depth.labels("generate").inc()
 
         if not leader:
             if not slot.done.wait(self.wait_timeout_s):
@@ -471,6 +479,8 @@ class GenerateCoalescer:
                     pend.closed = True
                     self._pending.pop(key, None)
             slots = pend.slots
+            if self.metrics is not None:
+                self.metrics.batcher_queue_depth.labels("generate").dec(len(slots))
             try:
                 if len(slots) == 1:
                     out = self.runtime.generate(

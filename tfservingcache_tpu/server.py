@@ -21,6 +21,7 @@ from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
 from tfservingcache_tpu.protocol.rest import RestServingServer
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("server")
 
@@ -221,6 +222,13 @@ class CacheNode:
 
 
 async def serve(cfg: Config) -> None:
+    # the process-wide tracer is configured once at server startup (tests
+    # construct Tracer instances directly and never pass through here)
+    TRACER.configure(
+        capacity=cfg.tracing.capacity,
+        slow_threshold_s=cfg.tracing.slow_threshold_ms / 1000.0,
+        slow_capacity=cfg.tracing.slow_capacity,
+    )
     node = CacheNode(cfg)
     rest_port, grpc_port = await node.start()
     log.info(

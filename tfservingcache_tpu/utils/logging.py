@@ -1,4 +1,12 @@
-"""Structured logging setup (reference: logrus config, cmd/taskhandler/cfg.go:28-61)."""
+"""Structured logging setup (reference: logrus config, cmd/taskhandler/cfg.go:28-61).
+
+``fmt=json`` lines are trace-correlated: a log call made anywhere inside a
+request's span tree (including serving-pool threads, which run under
+``contextvars.copy_context``) carries the request's ``trace_id``/``span``
+fields, so ``grep trace_id=... service.log`` reconstructs one request's log
+story and joins it to /monitoring/traces. Outside a request context the
+fields are absent — no empty-string spam for scrapers to special-case.
+"""
 
 from __future__ import annotations
 
@@ -6,6 +14,8 @@ import json
 import logging
 import sys
 import time
+
+from tfservingcache_tpu.utils.tracing import current_ids
 
 _LEVELS = {
     "trace": logging.DEBUG,
@@ -18,6 +28,13 @@ _LEVELS = {
     "panic": logging.CRITICAL,
 }
 
+# Attributes every LogRecord is born with — anything else on the record was
+# passed by the caller via ``extra={...}`` and belongs in the JSON payload.
+# (makeLogRecord keeps this version-proof: 3.12 added ``taskName``.)
+_STD_RECORD_KEYS = frozenset(vars(logging.makeLogRecord({}))) | {
+    "message", "asctime", "taskName",
+}
+
 
 class JsonFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
@@ -27,9 +44,19 @@ class JsonFormatter(logging.Formatter):
             "msg": record.getMessage(),
             "logger": record.name,
         }
+        ids = current_ids()
+        if ids is not None:
+            payload["trace_id"], payload["span"] = ids
+        for key, val in record.__dict__.items():
+            # logrus-style structured fields: emit extra={...} attributes
+            # (dropping them silently was the old behavior) without letting
+            # a caller clobber the core keys above
+            if key in _STD_RECORD_KEYS or key.startswith("_") or key in payload:
+                continue
+            payload[key] = val
         if record.exc_info:
             payload["exc"] = self.formatException(record.exc_info)
-        return json.dumps(payload)
+        return json.dumps(payload, default=str)
 
 
 def setup_logging(level: str = "info", fmt: str = "text") -> None:

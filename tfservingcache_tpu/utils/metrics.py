@@ -43,6 +43,32 @@ class Metrics:
         self.request_failures = Counter(
             "tfservingcache_proxy_failures", "The total number of failed requests", ["protocol"], registry=r
         )
+        # End-to-end client-experienced latency (no reference counterpart:
+        # its two histograms time only the ensure step). route=local is a
+        # request this node served itself; route=forwarded left via the ring
+        # to a hash-owned peer — the pair splits "the model was slow" from
+        # "the hop was slow" without a trace in hand.
+        self.request_duration = Histogram(
+            "tpusc_request_duration_seconds",
+            "End-to-end request latency as the client experienced it "
+            "(protocol=rest|grpc, verb=predict|classify|regress|generate|"
+            "metadata|status|..., outcome=ok|error, route=local|forwarded)",
+            ["protocol", "verb", "outcome", "route"],
+            registry=r,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1, 2.5, 5, 10, 30, 60),
+        )
+        self.requests_in_flight = Gauge(
+            "tpusc_requests_in_flight",
+            "Requests currently being served (admitted, response not yet sent)",
+            ["protocol"], registry=r,
+        )
+        self.batcher_queue_depth = Gauge(
+            "tpusc_batcher_queue_depth",
+            "Requests parked in a forming micro-batch, waiting for the "
+            "device gate (kind = predict | generate)",
+            ["kind"], registry=r,
+        )
         self.cache_total = Counter(
             "tfservingcache_cache", "Cache lookups", ["model"], registry=r
         )
