@@ -209,3 +209,36 @@ async def test_router_retries_dead_replica(tmp_path):
         finally:
             await routing.close()
             await cluster.disconnect()
+
+
+async def test_p2c_candidates_prefer_less_loaded_replica():
+    """Power-of-two-choices: with one replica carrying in-flight work, the
+    idle one must lead the candidate list every time (both samples land on
+    the same 2 nodes, so the pick is deterministic: fewer in-flight wins)."""
+    mock = DiscoveryServiceMock()
+    cluster = ClusterConnection(mock, replicas_per_model=2)
+    self_node = NodeInfo("10.0.0.0", 9000, 9100)
+    connect = asyncio.create_task(cluster.connect(self_node, lambda: True, wait_ready_s=2))
+    await asyncio.sleep(0.05)
+    mock.push(nodes_list(2))
+    await connect
+    routing = RoutingBackend(cluster)
+    try:
+        replicas = cluster.find_nodes_for_key("m##1")
+        assert len(replicas) == 2
+        busy, idle = replicas[0], replicas[1]
+        routing._inflight_inc(busy.ident)
+        routing._inflight_inc(busy.ident)
+        for _ in range(12):
+            assert routing._candidates("m", 1)[0].ident == idle.ident
+        # counts drain to zero -> dict entry is deleted (no ghost peers)
+        routing._inflight_dec(busy.ident)
+        routing._inflight_dec(busy.ident)
+        assert busy.ident not in routing._inflight
+        # with equal (zero) load both replicas must still get picked: the
+        # two-sample start keeps the spread property random rotation had
+        firsts = {routing._candidates("m", 1)[0].ident for _ in range(40)}
+        assert firsts == {busy.ident, idle.ident}
+    finally:
+        await routing.close()
+        await cluster.disconnect()

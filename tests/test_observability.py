@@ -453,6 +453,9 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_compile_duration_seconds",
     "tpusc_disk_cache_bytes_in_use",
     "tpusc_evictions",
+    "tpusc_gen_admission_wait_seconds",
+    "tpusc_gen_slots_active",
+    "tpusc_gen_wasted_steps",
     "tpusc_group_healthy",
     "tpusc_group_reform_events",
     "tpusc_hbm_bytes_in_use",

@@ -9,7 +9,10 @@ random per request (90-91). Differences by design:
   - requests whose hash lands on *this* node short-circuit to the local
     backend in-process instead of re-entering through localhost;
   - simple retry-on-next-replica for connection errors (the reference lists
-    retries as a TODO, README.md:72-74).
+    retries as a TODO, README.md:72-74);
+  - replica choice is power-of-two-choices over per-peer in-flight counts
+    rather than uniform random (the reference's rand.Intn pick), so a peer
+    wedged on a long :generate or cold compile stops collecting new work.
 """
 
 from __future__ import annotations
@@ -105,6 +108,11 @@ class RoutingBackend(ServingBackend):
         # from their own (identical) config; the label itself never needs to
         # cross the wire
         self.version_labels = dict(version_labels or {})
+        # per-peer in-flight request counts for power-of-two-choices replica
+        # selection (Mitzenmacher): the event loop is single-threaded, so a
+        # plain dict is race-free. Keyed by ring-member ident; entries are
+        # deleted at zero so departed peers don't accumulate ghost keys.
+        self._inflight: dict[str, int] = {}
         self._http: aiohttp.ClientSession | None = None
         cluster.on_update.append(self.pool.prune)
 
@@ -130,15 +138,35 @@ class RoutingBackend(ServingBackend):
         return self._http
 
     # -- routing core -------------------------------------------------------
+    def _inflight_inc(self, ident: str) -> None:
+        self._inflight[ident] = self._inflight.get(ident, 0) + 1
+
+    def _inflight_dec(self, ident: str) -> None:
+        n = self._inflight.get(ident, 0) - 1
+        if n <= 0:
+            self._inflight.pop(ident, None)
+        else:
+            self._inflight[ident] = n
+
     def _candidates(self, name: str, version: int | str | None) -> list[NodeInfo]:
-        """Replica set in random-start order (random pick + failover list)."""
+        """Replica set ordered for power-of-two-choices: sample two distinct
+        replicas, lead with the one carrying fewer in-flight requests, keep
+        the rest as the failover rotation. Uniform-random pick of 2 + least
+        loaded avoids both the herd of global-least-loaded and the variance
+        of plain random (a slow peer — long :generate, cold compile — keeps
+        collecting new work under pure random rotation)."""
         key = ModelId(name, int(version or 0)).key
         nodes = self.cluster.find_nodes_for_key(key)
         if not nodes:
             raise BackendError(
                 "no serving nodes in cluster", grpc.StatusCode.UNAVAILABLE, 503
             )
-        start = random.randrange(len(nodes))
+        if len(nodes) < 2:
+            return nodes
+        i, j = random.sample(range(len(nodes)), 2)
+        load_i = self._inflight.get(nodes[i].ident, 0)
+        load_j = self._inflight.get(nodes[j].ident, 0)
+        start = i if load_i <= load_j else j
         return nodes[start:] + nodes[:start]
 
     async def _forward_grpc(self, service: str, method: str, name: str, version, request):
@@ -155,7 +183,13 @@ class RoutingBackend(ServingBackend):
                     (SESSION_SERVICE, "SessionRun"): local.session_run,
                 }[(service, method)]
                 TRACER.annotate_root(route="local")
-                return await fn(request)
+                # local work counts toward p2c too — the local chip group is
+                # just another replica and can be the loaded one
+                self._inflight_inc(node.ident)
+                try:
+                    return await fn(request)
+                finally:
+                    self._inflight_dec(node.ident)
             # one route span per forwarding attempt; the peer adopts our
             # traceparent and ships its finished subtree back on the trailer
             with TRACER.span(
@@ -163,6 +197,7 @@ class RoutingBackend(ServingBackend):
             ) as route_sp:
                 TRACER.annotate_root(route="forwarded")
                 call = None
+                self._inflight_inc(node.ident)
                 try:
                     stub = await self.pool.stub(node)
                     tp = format_traceparent(route_sp)
@@ -183,6 +218,8 @@ class RoutingBackend(ServingBackend):
                         )
                         continue
                     raise
+                finally:
+                    self._inflight_dec(node.ident)
         assert last_err is not None
         raise last_err
 
@@ -264,7 +301,11 @@ class RoutingBackend(ServingBackend):
             local = self.local_backends.get(node.ident)
             if local is not None:
                 TRACER.annotate_root(route="local")
-                return await local.handle_rest(method, model_name, version, verb, body)
+                self._inflight_inc(node.ident)
+                try:
+                    return await local.handle_rest(method, model_name, version, verb, body)
+                finally:
+                    self._inflight_dec(node.ident)
             url = f"http://{node.host}:{node.rest_port}/v1/models/{model_name}"
             if version is not None:
                 url += f"/versions/{version}"
@@ -282,6 +323,7 @@ class RoutingBackend(ServingBackend):
                 tp = format_traceparent(route_sp)
                 if tp:
                     headers["traceparent"] = tp
+                self._inflight_inc(node.ident)
                 try:
                     async with self._http_session().request(
                         method, url, data=body or None, headers=headers
@@ -299,6 +341,8 @@ class RoutingBackend(ServingBackend):
                     last_err = e
                     log.warning("peer %s unreachable for REST %s: %s", node.ident, url, e)
                     continue
+                finally:
+                    self._inflight_dec(node.ident)
         raise BackendError(
             f"all replicas unreachable: {last_err}", grpc.StatusCode.UNAVAILABLE, 503
         )

@@ -67,6 +67,27 @@ class ServingConfig:
     # Host buffers the chunk assembler may run ahead of the H2D stream
     # (bounded queue depth; each slot holds up to one ~256 MB packed chunk).
     cold_pipeline_buffer_depth: int = 2
+    # :generate engine for the transformer_lm family. "coalesce" (default)
+    # keeps batch-formation-time coalescing (GenerateCoalescer): safe,
+    # proven, but a request arriving just after a batch launches waits for
+    # the whole fixed-length scan, and early-EOS rows burn padded steps
+    # until the batch drains. "continuous" enables the slotted
+    # iteration-level engine (runtime/batcher.py ContinuousGenerateEngine):
+    # a fixed slot array advanced by one compiled decode-chunk program,
+    # with admission at chunk boundaries and per-row retirement at EOS /
+    # max_new_tokens. Mesh/multi-process runtimes ignore "continuous" and
+    # take the coalesce path unconditionally (same rule as
+    # cold_load_pipeline: lockstep device-op streams must not depend on a
+    # host scheduler thread).
+    generate_engine: str = "coalesce"
+    # Slot count S of the continuous engine's decode array: one compiled
+    # program serves all S lanes; S bounds concurrent decodes per model.
+    generate_slots: int = 8
+    # Decode steps per device dispatch (chunk size k). k=1 retires rows
+    # with zero wasted steps; larger k amortizes host dispatch overhead at
+    # the cost of up to k-1 overshoot steps per finishing row (PERF.md
+    # "Continuous batching" discusses the tradeoff).
+    generate_chunk_tokens: int = 8
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

@@ -22,12 +22,22 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 
 from tfservingcache_tpu.models.transformer_lm import _rmsnorm
+
+# The slot-decode jits donate their K/V buffers (in-place update on TPU);
+# CPU/interpreter backends cannot honor donation and warn on EVERY dispatch
+# — steady-state noise at chunk cadence on the test harness, carrying no
+# action. The donation itself stays: it is the difference between rewriting
+# and reallocating a (layers, S, n_kv, max_seq, hd) array per chunk on HBM.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 
 def init_cache(cfg: dict, batch: int, max_len: int) -> dict:
@@ -184,6 +194,165 @@ def _generate_from_cache_jit(
     if return_cache:
         return toks, cache["k"], cache["v"]
     return toks
+
+
+def _sample_per_row(logits, rng, temperature, top_k):
+    """Per-row sampling params: logits (S, V), temperature (S,) f32,
+    top_k (S,) i32 -> token ids (S,). The continuous engine packs unrelated
+    requests into one slot array, so each lane carries its own sampling
+    config; the values stay TRACED for the same compile-DoS reason as
+    ``_sample``. One categorical draw covers all rows (matches the batched
+    stream structure)."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    k = jnp.clip(top_k.astype(jnp.int32), 0, v)
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, v - 1)[:, None], axis=-1
+    )
+    thresh = jnp.where(((k > 0) & (k < v))[:, None], kth, -jnp.inf)
+    filt = jnp.where(logits < thresh, -1e30, logits)
+    temp = jnp.maximum(temperature.astype(jnp.float32), 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, filt / temp, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg_key", "family"))
+def _slot_prefill_jit(
+    params,
+    input_ids,           # (1, S_pad) right-padded prompt
+    prompt_len,          # (1,)
+    rng,
+    temperature,         # scalar f32
+    top_k,               # scalar i32
+    *,
+    cfg_key,
+    family: str = "transformer_lm",
+):
+    """Prefill ONE prompt into a fresh (1, S_pad)-row cache and sample the
+    request's first token — the admission half of the continuous engine.
+    Returns (first_tok (1,), k, v); the first token's own K/V is NOT yet in
+    the cache (it sits at pos=prompt_len, written by the first decode-chunk
+    step — the same convention as ``_decode_scan``'s first_tok)."""
+    cfg = dict(cfg_key)
+    b, s_max = input_ids.shape
+    cache = init_cache(cfg, b, s_max)
+    logits, cache = _forward_cached_dyn(
+        params, input_ids, cache, jnp.zeros((b,), jnp.int32), cfg, family
+    )
+    last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
+    _, sub = jax.random.split(rng)
+    tok = _sample(last, sub, temperature, top_k)
+    return tok, cache["k"], cache["v"]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg_key", "family"))
+def _slot_prefill_from_cache_jit(
+    params,
+    suffix_ids,          # (1, S_suffix_pad)
+    suffix_len,          # (1,)
+    cached_k,            # (layers, 1, n_kv, Lpad, head_dim)
+    cached_v,
+    cached_len,          # (1,)
+    rng,
+    temperature,
+    top_k,
+    *,
+    cfg_key,
+    family: str = "transformer_lm",
+):
+    """Admission prefill continuing from a prefix-cache hit: copy the prefix
+    rows, prefill only the suffix, sample the first token. Same junk-row
+    safety argument as ``_generate_from_cache_jit``."""
+    cfg = dict(cfg_key)
+    b, s_pad = suffix_ids.shape
+    l_pad = cached_k.shape[3]
+    cache = init_cache(cfg, b, l_pad + s_pad)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], cached_k.astype(cache["k"].dtype), (0, 0, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], cached_v.astype(cache["v"].dtype), (0, 0, 0, 0, 0)
+        ),
+    }
+    start = cached_len.astype(jnp.int32)
+    logits, cache = _forward_cached_dyn(
+        params, suffix_ids, cache, start, cfg, family
+    )
+    last = jnp.take_along_axis(
+        logits, (suffix_len - 1)[:, None, None], axis=1
+    )[:, 0]
+    _, sub = jax.random.split(rng)
+    tok = _sample(last, sub, temperature, top_k)
+    return tok, cache["k"], cache["v"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _slot_insert_jit(slot_k, slot_v, pk, pv, idx):
+    """Copy one admitted request's prefill K/V (layers, 1, n_kv, P_pad, hd)
+    into slot row ``idx`` of the slot array (layers, S, n_kv, max_seq, hd).
+    ``idx`` is traced, so one compile serves every slot; donation makes the
+    copy in-place instead of reallocating the (large) slot array. Rows
+    beyond P_pad keep a previous occupant's stale K/V — never visible: a
+    query at pos p sees only rows <= p, and the decode step writes row p
+    before attending (the same write-before-read argument as prefill
+    padding)."""
+    idx = idx.astype(jnp.int32)
+    k = jax.lax.dynamic_update_slice(
+        slot_k, pk.astype(slot_k.dtype), (0, idx, 0, 0, 0)
+    )
+    v = jax.lax.dynamic_update_slice(
+        slot_v, pv.astype(slot_v.dtype), (0, idx, 0, 0, 0)
+    )
+    return k, v
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg_key", "family", "chunk"),
+    donate_argnums=(1, 2),
+)
+def _decode_chunk_jit(
+    params,
+    slot_k,              # (layers, S, n_kv, max_seq, head_dim) — donated
+    slot_v,
+    tok,                 # (S,) last sampled token per slot
+    pos,                 # (S,) i32 write position per slot
+    active,              # (S,) bool — frozen for the whole chunk
+    rngs,                # (chunk, 2) uint32 — one PRNG key per step
+    temperature,         # (S,) f32 per-slot
+    top_k,               # (S,) i32 per-slot
+    *,
+    cfg_key,
+    family: str = "transformer_lm",
+    chunk: int,
+):
+    """Advance every ACTIVE slot by ``chunk`` decode steps in one compiled
+    program — the continuous engine's only steady-state dispatch. Inactive
+    lanes ride along: their token/pos are frozen (``where(active, ...)``)
+    so each step just rewrites the same K/V at the frozen pos — junk for
+    never-admitted slots, a no-op rewrite for retired ones — and the host
+    ignores their emitted tokens. Admission/retirement happen on the host
+    BETWEEN chunks; a row finishing mid-chunk keeps decoding from its own
+    EOS until the chunk ends (the < chunk overshoot the wasted-steps
+    counter measures)."""
+    cfg = dict(cfg_key)
+
+    def step(carry, rng):
+        k, v, tok, pos = carry
+        logits, cache = _forward_cached_dyn(
+            params, tok[:, None], {"k": k, "v": v}, pos, cfg, family
+        )
+        nxt = _sample_per_row(logits[:, 0], rng, temperature, top_k)
+        nxt = jnp.where(active, nxt, tok)
+        pos = pos + active.astype(jnp.int32)
+        return (cache["k"], cache["v"], nxt, pos), nxt
+
+    (slot_k, slot_v, tok, pos), toks = jax.lax.scan(
+        step, (slot_k, slot_v, tok, pos), rngs, length=chunk
+    )
+    return slot_k, slot_v, tok, pos, jnp.transpose(toks, (1, 0))  # (S, chunk)
 
 
 def _ffn_block(layer: dict, x, cfg: dict, family: str, dtype):

@@ -68,6 +68,9 @@ class LocalServingBackend(ServingBackend):
         batch_window_ms: float = 0.0,
         batch_max_size: int = 64,
         batch_max_inflight: int = 4,
+        generate_engine: str = "coalesce",
+        generate_slots: int = 8,
+        generate_chunk_tokens: int = 8,
     ) -> None:
         self.manager = manager
         # JAX dispatch is effectively serialized per device; a few workers
@@ -98,6 +101,21 @@ class LocalServingBackend(ServingBackend):
         else:
             self._predictor = manager.runtime
             self._generator = None
+        # serving.generate_engine=continuous replaces whichever generator the
+        # batching knob picked with the slotted continuous-decode engine
+        # (step-boundary admission / early retirement; runtime/batcher.py).
+        # Mesh runtimes keep the coalescer unconditionally: the slot engine's
+        # dynamic-index cache writes aren't sharding-annotated, same rule as
+        # serving.cold_load_pipeline.
+        if generate_engine == "continuous" and getattr(manager.runtime, "mesh", None) is None:
+            from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+
+            self._generator = ContinuousGenerateEngine(
+                manager.runtime,
+                slots=generate_slots,
+                chunk_tokens=generate_chunk_tokens,
+                metrics=manager.metrics,
+            )
 
     async def _run(self, fn, *args):
         # copy_context: the executor job joins the request's ambient trace
@@ -769,4 +787,7 @@ class LocalServingBackend(ServingBackend):
         return RestResponse(status=200, body=json.dumps(out).encode())
 
     def close(self) -> None:
+        gen_close = getattr(self._generator, "close", None)
+        if gen_close is not None:
+            gen_close()
         self._pool.shutdown(wait=False, cancel_futures=True)

@@ -35,14 +35,20 @@ Models whose inputs have no named "batch" axis fall through unbatched.
 
 from __future__ import annotations
 
+import collections
 import secrets
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Mapping
 
 import numpy as np
 
-from tfservingcache_tpu.runtime.base import BaseRuntime
+from tfservingcache_tpu.runtime.base import (
+    BaseRuntime,
+    ModelNotLoadedError,
+    RuntimeError_,
+)
 from tfservingcache_tpu.types import ModelId
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.tracing import TRACER
@@ -350,6 +356,7 @@ class _GenSlot:
     ids: np.ndarray                       # (rows, s_i) int32 prompts
     lengths: np.ndarray                   # (rows,) true prompt lengths
     max_new: int
+    enqueue_t: float = field(default_factory=time.monotonic)
     done: threading.Event = field(default_factory=threading.Event)
     result: np.ndarray | None = None
     error: BaseException | None = None
@@ -481,6 +488,14 @@ class GenerateCoalescer:
             slots = pend.slots
             if self.metrics is not None:
                 self.metrics.batcher_queue_depth.labels("generate").dec(len(slots))
+                # head-of-line stall, on the SAME metric the continuous
+                # engine records its slot wait: decoding starts on every
+                # joiner's behalf the moment its leader holds the gate
+                now = time.monotonic()
+                for sl in slots:
+                    self.metrics.gen_admission_wait.labels("coalesce").observe(
+                        max(0.0, now - sl.enqueue_t)
+                    )
             try:
                 if len(slots) == 1:
                     out = self.runtime.generate(
@@ -489,6 +504,7 @@ class GenerateCoalescer:
                         top_k=top_k, seed=secrets.randbits(31),
                     )
                     slot.result = out
+                    self._observe_waste(model_id, [slot], slot.max_new)
                     return out
                 with TRACER.span(
                     "generate_coalesce", model=str(model_id),
@@ -518,6 +534,9 @@ class GenerateCoalescer:
                         hi = lo + sl.ids.shape[0]
                         sl.result = toks[lo:hi, : sl.max_new]
                         lo = hi
+                    self._observe_waste(
+                        model_id, slots, max(sl.max_new for sl in slots)
+                    )
                 assert slot.result is not None
                 return slot.result
             except BaseException as e:
@@ -530,3 +549,401 @@ class GenerateCoalescer:
                 for sl in slots:
                     if sl is not slot:
                         sl.done.set()
+
+    def _observe_waste(
+        self, model_id: ModelId, slots: list[_GenSlot], batch_max_new: int
+    ) -> None:
+        """Post-hoc padded-step accounting: the batch's scan computed
+        ``next_bucket(batch_max_new)`` decode steps for EVERY row, so a row
+        that hit EOS (when the model declares one) or whose own max_new was
+        below the batch's kept burning steps until the drain. An estimate —
+        the runtime falls back to exact sizes on bucket overshoot — but the
+        comparison the metric exists for (coalesce vs continuous on one
+        workload) uses models/workloads where the bucket estimate is exact."""
+        if self.metrics is None:
+            return
+        eos = getattr(self.runtime, "eos_id_of", lambda _m: None)(model_id)
+        steps = _next_bucket(batch_max_new)
+        wasted = 0
+        for sl in slots:
+            if sl.result is None:
+                continue
+            for row in np.asarray(sl.result):
+                useful = row.shape[0]
+                if eos is not None:
+                    hits = np.flatnonzero(row == eos)
+                    if hits.size:
+                        useful = int(hits[0]) + 1
+                wasted += steps - useful
+        if wasted > 0:
+            self.metrics.gen_wasted_steps.labels("coalesce").inc(wasted)
+
+
+@dataclass
+class _ContinuousReq:
+    """One ROW of a continuous generate (multi-row requests split into
+    per-row units so each row admits and retires independently)."""
+
+    prompt: np.ndarray                    # (P,) true prompt tokens
+    max_new: int
+    temperature: float
+    top_k: int
+    enqueue_t: float = field(default_factory=time.monotonic)
+    done: threading.Event = field(default_factory=threading.Event)
+    tokens: list[int] = field(default_factory=list)
+    error: BaseException | None = None
+    admitted_t: float | None = None
+    first_tok_t: float | None = None
+    finish_t: float | None = None
+    prefix_hit: bool = False
+
+
+class _ContinuousScheduler:
+    """One model's decode loop: a dedicated thread that admits pending rows
+    into free slot lanes at chunk boundaries, dispatches the compiled
+    decode-chunk program over the slot array, and retires rows the moment
+    they hit EOS or their own max_new_tokens — freeing the lane for the
+    next pending row instead of waiting for a batch drain."""
+
+    def __init__(self, engine: "ContinuousGenerateEngine", model_id: ModelId) -> None:
+        self.engine = engine
+        self.model_id = model_id
+        self.cv = threading.Condition()
+        self.pending: collections.deque[_ContinuousReq] = collections.deque()
+        self.stopped = False
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"tpusc-cdecode-{model_id.name}",
+        )
+        self.thread.start()
+
+    def submit(self, reqs: list[_ContinuousReq]) -> None:
+        with self.cv:
+            if self.stopped:
+                raise RuntimeError_("continuous generate engine is closed")
+            self.pending.extend(reqs)
+            if self.engine.metrics is not None:
+                self.engine.metrics.batcher_queue_depth.labels("generate").inc(
+                    len(reqs)
+                )
+            self.cv.notify()
+
+    def _fail(self, reqs: list[_ContinuousReq], err: BaseException) -> None:
+        for r in reqs:
+            if r.error is None and not r.done.is_set():
+                r.error = err
+                r.done.set()
+
+    def _loop(self) -> None:
+        rt = self.engine.runtime
+        lanes: list[_ContinuousReq | None] = [None] * self.engine.slots
+        state = None
+        while True:
+            with self.cv:
+                while (
+                    not self.pending
+                    and not any(l is not None for l in lanes)
+                    and not self.stopped
+                ):
+                    self.cv.wait()
+                if self.stopped:
+                    doomed = [l for l in lanes if l is not None]
+                    doomed += list(self.pending)
+                    self.pending.clear()
+                    break
+            try:
+                state = self._step(rt, state, lanes)
+            except BaseException as e:  # noqa: BLE001 - fail the in-flight rows
+                # eviction mid-decode (ModelNotLoadedError) or a device
+                # failure: every in-flight AND queued row gets the error —
+                # the slot state may hold poisoned K/V, so it's dropped and
+                # the next submit starts clean (the backend's retry-once
+                # ensure_servable path re-admits evicted-model requests)
+                with self.cv:
+                    doomed = [l for l in lanes if l is not None]
+                    doomed += list(self.pending)
+                    self.pending.clear()
+                lanes = [None] * self.engine.slots
+                self._fail(doomed, e)
+                try:
+                    rt.drop_slot_state(self.model_id)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+                state = None
+                self.engine._set_active(self.model_id, 0)
+        self._fail(doomed, RuntimeError_("continuous generate engine closed"))
+        self.engine._set_active(self.model_id, 0)
+
+    def _step(self, rt, state, lanes):
+        """One chunk boundary: admit into free lanes, then advance all
+        active lanes by one compiled chunk. Called only from self.thread."""
+        eng = self.engine
+        eos = getattr(rt, "eos_id_of", lambda _m: None)(self.model_id)
+        free = [i for i, l in enumerate(lanes) if l is None]
+        admitted_any = False
+        while free:
+            with self.cv:
+                if not self.pending:
+                    break
+                req = self.pending.popleft()
+                if eng.metrics is not None:
+                    eng.metrics.batcher_queue_depth.labels("generate").dec()
+            try:
+                if state is None:
+                    state = rt.slot_decode_state(self.model_id, eng.slots)
+                p = req.prompt.shape[0]
+                if p + req.max_new > state.max_seq:
+                    req.error = RuntimeError_(
+                        f"prompt {p} + max_new_tokens {req.max_new} exceeds "
+                        f"max_seq {state.max_seq}"
+                    )
+                    req.done.set()
+                    continue
+                tok, pk, pv, hit = rt.slot_prefill(
+                    self.model_id, req.prompt, req.temperature, req.top_k,
+                    seed=secrets.randbits(31),
+                )
+            except BaseException as e:  # noqa: BLE001
+                # the req is already out of `pending` and not yet in `lanes`
+                # — without this the _loop doom sweep would miss it and its
+                # waiter would block until timeout
+                self._fail([req], e)
+                raise
+            now = time.monotonic()
+            req.admitted_t = req.first_tok_t = now
+            req.prefix_hit = hit
+            req.tokens.append(int(tok))
+            eng.admitted += 1
+            admitted_any = True
+            if eng.metrics is not None:
+                eng.metrics.gen_admission_wait.labels("continuous").observe(
+                    max(0.0, now - req.enqueue_t)
+                )
+            if (eos is not None and int(tok) == eos) or req.max_new <= 1:
+                # done at prefill: the lane was never consumed
+                req.finish_t = now
+                req.done.set()
+                continue
+            idx = free.pop()
+            rt.slot_admit(state, idx, pk, pv)
+            state.tok[idx] = int(tok)
+            state.pos[idx] = p
+            state.active[idx] = True
+            state.temps[idx] = req.temperature
+            state.topks[idx] = req.top_k
+            lanes[idx] = req
+        if admitted_any:
+            eng._set_active(
+                self.model_id, sum(l is not None for l in lanes)
+            )
+        if not any(l is not None for l in lanes):
+            return state
+        # chunk clamped to the pow2 cover of the largest remaining budget:
+        # when every active row needs < chunk_tokens more, a smaller
+        # compiled chunk (log2-bounded program count) trims the overshoot
+        max_remaining = max(
+            l.max_new - len(l.tokens) for l in lanes if l is not None
+        )
+        chunk = max(1, min(eng.chunk_tokens, _next_bucket(max_remaining)))
+        toks = rt.slot_decode_chunk(state, chunk)
+        eng.chunks += 1
+        now = time.monotonic()
+        wasted = 0
+        for idx, req in enumerate(lanes):
+            if req is None:
+                continue
+            for j in range(chunk):
+                t = int(toks[idx, j])
+                req.tokens.append(t)
+                if (eos is not None and t == eos) or len(req.tokens) >= req.max_new:
+                    # retire NOW: steps the chunk computed past this point
+                    # were for a finished request — the waste continuous
+                    # batching exists to bound (< chunk, vs batch-drain
+                    # padding under coalesce)
+                    wasted += chunk - (j + 1)
+                    state.active[idx] = False
+                    lanes[idx] = None
+                    req.finish_t = now
+                    req.done.set()
+                    break
+        if wasted and eng.metrics is not None:
+            eng.metrics.gen_wasted_steps.labels("continuous").inc(wasted)
+        eng._set_active(self.model_id, sum(l is not None for l in lanes))
+        return state
+
+
+class ContinuousGenerateEngine:
+    """Iteration-level continuous batching for ``:generate`` — the vLLM-/
+    DeepServe-style alternative to GenerateCoalescer, selected via
+    ``serving.generate_engine=continuous``.
+
+    Where the coalescer decides membership once at batch-formation time
+    (a request arriving 50 ms after launch waits out the whole fixed-length
+    scan, and early-EOS rows burn padded steps until the drain), this
+    engine keeps a fixed-capacity slot array per model (static shapes — one
+    compiled decode-chunk program regardless of which lanes are live) and
+    makes both decisions at every chunk boundary: pending rows admit into
+    free lanes (prompt prefilled via the prefix-cache-aware slot prefill),
+    finished rows retire immediately.
+
+    Scope mirrors the coalescer's exclusions: explicitly seeded requests
+    (reproducible solo stream), non-transformer_lm families, malformed
+    params, and mesh runtimes (same rule as the cold-load pipeline: a
+    lockstep device-op stream must not depend on a host scheduler thread)
+    all fall through to ``runtime.generate``.
+    """
+
+    def __init__(
+        self,
+        runtime: BaseRuntime,
+        slots: int = 8,
+        chunk_tokens: int = 8,
+        wait_timeout_s: float = 600.0,
+        metrics=None,
+    ) -> None:
+        self.runtime = runtime
+        self.slots = max(1, int(slots))
+        self.chunk_tokens = max(1, int(chunk_tokens))
+        self.wait_timeout_s = wait_timeout_s
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._scheds: dict[ModelId, _ContinuousScheduler] = {}
+        self._active: dict[ModelId, int] = {}
+        self._closed = False
+        # observability (tests + bench)
+        self.admitted = 0
+        self.chunks = 0
+
+    def _set_active(self, model_id: ModelId, n: int) -> None:
+        with self._lock:
+            if n:
+                self._active[model_id] = n
+            else:
+                self._active.pop(model_id, None)
+            total = sum(self._active.values())
+        if self.metrics is not None:
+            self.metrics.gen_slots_active.set(total)
+
+    def _sched(self, model_id: ModelId) -> _ContinuousScheduler:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError_("continuous generate engine is closed")
+            s = self._scheds.get(model_id)
+            if s is None:
+                s = _ContinuousScheduler(self, model_id)
+                self._scheds[model_id] = s
+            return s
+
+    def generate(
+        self,
+        model_id: ModelId,
+        input_ids: np.ndarray,
+        prompt_lengths: list[int] | None = None,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        seed: int | None = None,
+        return_stats: bool = False,
+    ) -> np.ndarray:
+        """Drop-in for GenerateCoalescer.generate: (rows, max_new_tokens)
+        int32. A row that hit EOS early is zero-padded after it (the solo
+        path has no EOS concept and always fills max_new_tokens — identical
+        when the model declares no eos_id). ``return_stats`` additionally
+        returns per-row timing dicts (ttft_s, admission_wait_s, tokens) —
+        the bench's streaming-TTFT surface."""
+        ids = np.asarray(input_ids, np.int32)
+        family = getattr(self.runtime, "family_of", lambda _m: None)(model_id)
+        solo = (
+            seed is not None
+            or getattr(self.runtime, "mesh", None) is not None
+            or ids.ndim != 2
+            or not ids.size
+            or family != "transformer_lm"
+        )
+        lengths = None
+        if not solo:
+            rows, s = ids.shape
+            if prompt_lengths is None:
+                lengths = np.full((rows,), s, np.int32)
+            else:
+                lengths = np.asarray(prompt_lengths, np.int32)
+                if (
+                    lengths.shape != (rows,)
+                    or (lengths < 1).any()
+                    or (lengths > s).any()
+                ):
+                    solo = True  # runtime raises its own clean error
+            if not solo and (
+                max_new_tokens < 1
+                or not np.isfinite(temperature)
+                or temperature < 0.0
+                or top_k < 0
+            ):
+                solo = True
+        if solo:
+            out = self.runtime.generate(
+                model_id, ids, prompt_lengths=prompt_lengths,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k,
+                seed=seed if seed is not None else secrets.randbits(31),
+            )
+            return (out, None) if return_stats else out
+
+        reqs = [
+            _ContinuousReq(
+                prompt=ids[r, : lengths[r]].copy(),
+                max_new=int(max_new_tokens),
+                temperature=float(temperature),
+                top_k=int(top_k),
+            )
+            for r in range(rows)
+        ]
+        self._sched(model_id).submit(reqs)
+        deadline = time.monotonic() + self.wait_timeout_s
+        for r in reqs:
+            if not r.done.wait(max(0.0, deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"continuous generate for {model_id} timed out"
+                )
+        for r in reqs:
+            if r.error is not None:
+                raise r.error
+        out = np.zeros((rows, max_new_tokens), np.int32)
+        for i, r in enumerate(reqs):
+            t = np.asarray(r.tokens[:max_new_tokens], np.int32)
+            out[i, : t.shape[0]] = t
+        # span annotation from the CALLER's thread (the scheduler thread has
+        # no ambient trace — a span opened there would be an orphan root)
+        TRACER.annotate(
+            gen_engine="continuous",
+            gen_admission_wait_ms=round(
+                1e3 * max(
+                    (r.admitted_t or r.enqueue_t) - r.enqueue_t for r in reqs
+                ), 3,
+            ),
+            gen_prefix_hits=sum(1 for r in reqs if r.prefix_hit),
+        )
+        if return_stats:
+            stats = [
+                {
+                    "ttft_s": (r.first_tok_t or r.enqueue_t) - r.enqueue_t,
+                    "admission_wait_s": (r.admitted_t or r.enqueue_t)
+                    - r.enqueue_t,
+                    "tokens": len(r.tokens[:max_new_tokens]),
+                }
+                for r in reqs
+            ]
+            return out, stats
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            scheds = list(self._scheds.values())
+            self._scheds.clear()
+        for s in scheds:
+            with s.cv:
+                s.stopped = True
+                s.cv.notify_all()
+        for s in scheds:
+            s.thread.join(timeout=5.0)

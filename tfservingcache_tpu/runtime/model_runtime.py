@@ -339,6 +339,30 @@ class LoadedModel:
     load_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
+@dataclass
+class SlotDecodeState:
+    """Device + host state of one model's continuous-decode slot array
+    (runtime/batcher.py ContinuousGenerateEngine). The K/V arrays are
+    (layers, S, n_kv, max_seq, head_dim) — one lane per slot, advanced by
+    ``_decode_chunk_jit`` and surgically written by admission inserts. The
+    host mirrors (tok/pos/active/temps/topks) are owned by the engine's
+    scheduler thread; the runtime only reads them to build chunk inputs."""
+
+    model_id: ModelId
+    cfg_key: tuple
+    family: str
+    slots: int
+    max_seq: int
+    k: Any                           # device (layers, S, n_kv, max_seq, hd)
+    v: Any
+    tok: np.ndarray                  # (S,) i32 — last sampled token per lane
+    pos: np.ndarray                  # (S,) i32 — next write position
+    active: np.ndarray               # (S,) bool
+    temps: np.ndarray                # (S,) f32 per-lane temperature
+    topks: np.ndarray                # (S,) i32 per-lane top_k
+    chunk_counter: int = 0           # host-side PRNG stream for chunk keys
+
+
 class TPUModelRuntime(BaseRuntime):
     def __init__(
         self,
@@ -416,6 +440,13 @@ class TPUModelRuntime(BaseRuntime):
         self._aot_futures: dict[tuple[str, tuple], Any] = {}
         self._aot_lock = threading.Lock()
         self._compile_pool: Any = None  # lazy 1-thread executor
+        # continuous-decode slot arrays (ContinuousGenerateEngine), one per
+        # model with in-flight continuous generates. Their K/V HBM is
+        # engine-owned working memory (like the prefix cache's budget, it is
+        # NOT charged to the resident-model LRU) and dies with the model:
+        # _on_evict / reset_group_state / close all drop it.
+        self._slot_states: dict[ModelId, SlotDecodeState] = {}
+        self._slot_lock = threading.Lock()
 
     # -- load ---------------------------------------------------------------
     def ensure_loaded(self, model: Model) -> None:
@@ -1113,12 +1144,175 @@ class TPUModelRuntime(BaseRuntime):
             toks = np.asarray(jax.device_get(toks))
         return toks[:b, :max_new_tokens]
 
+    # -- continuous-decode slot surface (ContinuousGenerateEngine) ----------
+    def eos_id_of(self, model_id: ModelId) -> int | None:
+        """The model's EOS token id when its config declares one (an
+        optional ``eos_id`` key — toy artifacts and tests set it; absent
+        means no early stopping). None when unset or the model is not
+        resident."""
+        loaded = self._resident.get(model_id, touch=False)
+        if loaded is None:
+            return None
+        eos = loaded.model_def.config.get("eos_id")
+        return None if eos is None else int(eos)
+
+    def slot_decode_state(self, model_id: ModelId, slots: int) -> SlotDecodeState:
+        """Create-or-get the model's slot array. One compiled decode-chunk
+        program serves all ``slots`` lanes; the array is allocated once at
+        (layers, slots, n_kv, max_seq, head_dim) and reused across requests
+        (admission overwrites a freed lane's rows before any query can read
+        them — see _slot_insert_jit)."""
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        if loaded.model_def.family != "transformer_lm":
+            raise RuntimeError_(
+                "continuous decode supports transformer_lm only, not "
+                f"{loaded.model_def.family!r}"
+            )
+        with self._slot_lock:
+            st = self._slot_states.get(model_id)
+            if st is not None:
+                return st
+        from tfservingcache_tpu.models.generation import init_cache
+
+        cfg = loaded.model_def.config
+        cache = init_cache(cfg, slots, cfg["max_seq"])
+        st = SlotDecodeState(
+            model_id=model_id,
+            cfg_key=tuple(sorted((k, v) for k, v in cfg.items())),
+            family=loaded.model_def.family,
+            slots=slots,
+            max_seq=int(cfg["max_seq"]),
+            k=cache["k"],
+            v=cache["v"],
+            tok=np.zeros((slots,), np.int32),
+            pos=np.zeros((slots,), np.int32),
+            active=np.zeros((slots,), bool),
+            temps=np.zeros((slots,), np.float32),
+            topks=np.zeros((slots,), np.int32),
+        )
+        with self._slot_lock:
+            return self._slot_states.setdefault(model_id, st)
+
+    def drop_slot_state(self, model_id: ModelId) -> None:
+        with self._slot_lock:
+            self._slot_states.pop(model_id, None)
+
+    def slot_prefill(
+        self,
+        model_id: ModelId,
+        prompt: np.ndarray,          # (P,) true prompt tokens, no padding
+        temperature: float,
+        top_k: int,
+        seed: int,
+    ) -> tuple[int, Any, Any, bool]:
+        """Admission prefill for one request: run the prompt through a
+        (1, P_bucket)-row prefill (reusing a prefix-cache hit's rows when
+        one exists — reuse ONLY; the continuous engine never inserts back,
+        its completions live in the slot array, not in cache entries) and
+        sample the request's first token. -> (first_token, k, v, prefix_hit)
+        with k/v ready for ``slot_admit``."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import (
+            _slot_prefill_from_cache_jit,
+            _slot_prefill_jit,
+        )
+
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        cfg = loaded.model_def.config
+        cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        max_seq = int(cfg["max_seq"])
+        rng = jax.random.PRNGKey(seed)
+        temp = np.float32(temperature)
+        tk = np.int32(top_k)
+
+        hit = None
+        if self._prefix_cache is not None:
+            hit = self._prefix_cache.lookup(model_id, prompt)
+            if hit is not None:
+                s_pad = next_bucket(p - hit.valid_len)
+                if hit.k.shape[3] + s_pad > max_seq:
+                    hit = None  # padded hit would overflow the slot lane
+            if self.metrics is not None:
+                (self.metrics.prefix_cache_hits if hit is not None
+                 else self.metrics.prefix_cache_misses).inc()
+        if hit is not None:
+            ids = prompt[None, :]
+            suffix, suffix_len = self._prefix_suffix(ids, p, hit)
+            tok, pk, pv = _slot_prefill_from_cache_jit(
+                loaded.params, suffix,
+                np.asarray([suffix_len], np.int32),
+                hit.k, hit.v, np.asarray([hit.valid_len], np.int32),
+                rng, temp, tk, cfg_key=cfg_key,
+                family=loaded.model_def.family,
+            )
+        else:
+            s_pad = next_bucket(p)
+            if s_pad > max_seq:
+                s_pad = p  # bucket overshoot: exact size (same rule as generate)
+            ids = np.zeros((1, s_pad), np.int32)
+            ids[0, :p] = prompt
+            tok, pk, pv = _slot_prefill_jit(
+                loaded.params, ids, np.asarray([p], np.int32),
+                rng, temp, tk, cfg_key=cfg_key,
+                family=loaded.model_def.family,
+            )
+        return int(np.asarray(tok)[0]), pk, pv, hit is not None
+
+    def slot_admit(self, state: SlotDecodeState, idx: int, pk: Any, pv: Any) -> None:
+        """Copy an admitted request's prefill K/V into slot lane ``idx``
+        (in-place via donation). The caller (scheduler thread) owns the host
+        mirrors and sets tok/pos/active/temps/topks itself."""
+        from tfservingcache_tpu.models.generation import _slot_insert_jit
+
+        state.k, state.v = _slot_insert_jit(
+            state.k, state.v, pk, pv, np.int32(idx)
+        )
+
+    def slot_decode_chunk(self, state: SlotDecodeState, chunk: int) -> np.ndarray:
+        """Advance every active lane by ``chunk`` decode steps in one
+        dispatch; updates the state's device K/V and host tok/pos mirrors
+        and returns the (S, chunk) emitted tokens. Raises
+        ModelNotLoadedError when the model was evicted mid-decode (the
+        engine fails its in-flight requests and drops the state)."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import _decode_chunk_jit
+
+        loaded = self._resident.get(state.model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {state.model_id} is not loaded")
+        state.chunk_counter += 1
+        rngs = jax.random.split(
+            jax.random.PRNGKey(state.chunk_counter), chunk
+        )
+        state.k, state.v, tok, pos, toks = _decode_chunk_jit(
+            loaded.params, state.k, state.v,
+            state.tok, state.pos, state.active, rngs,
+            state.temps, state.topks,
+            cfg_key=state.cfg_key, family=state.family, chunk=chunk,
+        )
+        # np.array (not asarray): device_get hands back READ-ONLY views and
+        # the scheduler writes these mirrors at the next admission
+        state.tok = np.array(jax.device_get(tok), dtype=np.int32)
+        state.pos = np.array(jax.device_get(pos), dtype=np.int32)
+        return np.asarray(jax.device_get(toks))
+
     # -- unload / introspection --------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
         self._set_state(model_id, ModelState.UNLOADING)
         if self._prefix_cache is not None:
             # an unloaded model's prefix KV must not outlive it in HBM
             self._prefix_cache.drop_model(model_id)
+        # likewise the continuous engine's slot K/V (the engine's next
+        # dispatch sees ModelNotLoadedError and fails its in-flight rows)
+        self.drop_slot_state(model_id)
         with self._spec_lock:
             # acceptance history dies with either half of the pair (a
             # re-loaded model or new draft version starts fresh)
@@ -1450,6 +1644,8 @@ class TPUModelRuntime(BaseRuntime):
             self._resident.remove(mid, run_callback=True)
         if self._prefix_cache is not None:
             self._prefix_cache.clear()
+        with self._slot_lock:
+            self._slot_states.clear()
         with self._spec_lock:
             self._spec_health.clear()
 
@@ -1461,6 +1657,8 @@ class TPUModelRuntime(BaseRuntime):
 
     def close(self) -> None:
         self._resident.clear()
+        with self._slot_lock:
+            self._slot_states.clear()
         with self._jit_lock:
             self._jitted_by_key.clear()
         with self._aot_lock:

@@ -146,6 +146,9 @@ class CacheNode:
                 manager,
                 batch_window_ms=cfg.serving.batch_window_ms,
                 batch_max_size=cfg.serving.batch_max_size,
+                generate_engine=cfg.serving.generate_engine,
+                generate_slots=cfg.serving.generate_slots,
+                generate_chunk_tokens=cfg.serving.generate_chunk_tokens,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first

@@ -126,6 +126,34 @@ class Metrics:
             "tpusc_coalesced_requests", "Requests served via a coalesced call",
             ["kind"], registry=r,
         )
+        # iteration-level continuous batching (runtime/batcher.py
+        # ContinuousGenerateEngine). The engine label makes coalesce vs
+        # continuous comparable on the SAME metric: the coalescer records
+        # its head-of-line gate stall and post-hoc padded-step waste under
+        # engine="coalesce".
+        self.gen_slots_active = Gauge(
+            "tpusc_gen_slots_active",
+            "Decode slots currently occupied by in-flight generate requests "
+            "(summed across models; capacity is serving.generate_slots per "
+            "model)",
+            registry=r,
+        )
+        self.gen_wasted_steps = Counter(
+            "tpusc_gen_wasted_steps",
+            "Decode steps computed for a row AFTER its request already "
+            "finished (EOS or its own max_new_tokens): batch-drain padding "
+            "under coalesce, chunk overshoot (< chunk size) under continuous",
+            ["engine"], registry=r,
+        )
+        self.gen_admission_wait = Histogram(
+            "tpusc_gen_admission_wait_seconds",
+            "Time a generate request waited before decoding began on its "
+            "behalf: slot-free wait under continuous, in-flight gate stall "
+            "under coalesce",
+            ["engine"], registry=r,
+            buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25,
+                     .5, 1, 2.5, 5, 10),
+        )
         self.assignment_warms = Counter(
             "tpusc_assignment_warms_total",
             "Models pre-loaded by the ring-assignment warmer",
