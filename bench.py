@@ -163,7 +163,8 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
                 config: dict | None = None, resident_cap: int | None = None,
                 quantize: str | None = None, prefix_cache_bytes: int = 0,
                 cold_load_pipeline: bool | None = None,
-                compile_cache_dir: str | None = None):
+                compile_cache_dir: str | None = None,
+                host_tier_bytes: int = 0, metrics=None):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
@@ -196,9 +197,11 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
             ),
             **({} if cold_load_pipeline is None
                else {"cold_load_pipeline": cold_load_pipeline}),
-        )
+        ),
+        metrics,
+        host_tier_bytes=host_tier_bytes,
     )
-    manager = CacheManager(provider, cache, runtime)
+    manager = CacheManager(provider, cache, runtime, metrics)
     # crash-path leak tracking: a section that errors mid-body never
     # reaches its manager.close(), leaving runtime threads + pinned HBM
     # under later sections' measurements on the one chip. _section() closes
@@ -274,7 +277,7 @@ SECTION_GROUPS = (
     "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
-    "cold_pipeline",
+    "warm_tier", "cold_pipeline",
 )
 
 
@@ -1267,6 +1270,163 @@ def bench_cold_pipeline(tmp: str) -> dict:
     return out
 
 
+def bench_warm_tier(tmp: str) -> dict:
+    """Host-RAM warm tier (cache/host_tier.py): promotion vs store-path
+    reload, then the zipf churn soak with the tier off vs on.
+
+    Part 1 — same transformer_lm preset and simulated 30 MB/s object-store
+    wire rate as the cold_pipeline section, SAME for both arms: the
+    store-path arm drops the artifact from the disk cache (which discards
+    the host-tier entry too — inclusive tiers) so each rep pays fetch +
+    decode + transfer; the promotion arm only drops HBM residency so each
+    rep replays the retained packed chunks. Arms are path-verified through
+    the tpusc_reload_source counter — an arm that didn't take its intended
+    tier fails the section rather than reporting a meaningless ratio.
+
+    Part 2 — the tenant-churn soak re-run (identical seeded zipf schedule
+    both arms, mnist_cnn so artifact decode is non-trivial) with
+    ``host_tier_bytes`` 0 vs a budget sized to hold ~2x the HBM slot
+    count. Reports reload (miss) p50/p95 per arm and the reload_source
+    mix, i.e. what share of evicted-model reloads the tier absorbed."""
+    import numpy as np
+
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    out: dict = {}
+
+    # -- part 1: promotion vs store-path reload ------------------------------
+    reps = 3
+    metrics = Metrics()
+    manager, runtime = _make_stack(
+        "transformer_lm", 1, os.path.join(tmp, "wt-lm"),
+        config=COLD_PIPE_LM_CONFIG, host_tier_bytes=4 << 30, metrics=metrics,
+    )
+    manager.provider = _NetSimDiskProvider(manager.provider, COLD_PIPE_NET_MBPS)
+    mid = ModelId("tenant0", 1)
+    inputs = _example_inputs("transformer_lm", 1, COLD_PIPE_LM_CONFIG, lm_seq=1)
+    manager.ensure_servable(mid)
+    runtime.predict(mid, inputs)
+
+    def _src(tier: str) -> float:
+        return metrics.reload_source.labels(tier)._value.get()
+
+    def _timed_reload() -> float:
+        t0 = time.perf_counter()
+        manager.ensure_servable(mid)
+        runtime.predict(mid, inputs)
+        return time.perf_counter() - t0
+
+    store_s, host_s = [], []
+    for _ in range(reps):
+        # true store path: disk eviction discards the host entry with the
+        # artifact, so the reload pays wire + decode + transfer again
+        before = _src("store")
+        manager.disk_cache.remove(mid)
+        manager.disk_cache.drain_evictions()
+        runtime.drain_demotions()
+        store_s.append(_timed_reload())
+        if _src("store") != before + 1:
+            raise RuntimeError(
+                "warm_tier store arm did not take the store path — "
+                "the host-tier entry survived the disk eviction"
+            )
+    for _ in range(reps):
+        before = _src("host")
+        runtime.unload(mid)  # demotion: HBM drops, packed chunks stay
+        runtime.drain_demotions()
+        host_s.append(_timed_reload())
+        if _src("host") != before + 1:
+            raise RuntimeError(
+                "warm_tier promotion arm did not promote — no retained "
+                "entry at reload time"
+            )
+    tier_bytes = runtime._host_tier.size_of(mid)
+    manager.close()
+    store_s.sort(); host_s.sort()
+    store_p50 = store_s[len(store_s) // 2]
+    host_p50 = host_s[len(host_s) // 2]
+    out["promotion"] = {
+        "family": "transformer_lm",
+        "net_mbps": COLD_PIPE_NET_MBPS,
+        "reps": reps,
+        "store_reload_p50_s": round(store_p50, 3),
+        "host_reload_p50_s": round(host_p50, 3),
+        "packed_entry_mb": round(tier_bytes / (1 << 20), 1),
+        "speedup": round(store_p50 / max(host_p50, 1e-9), 1),
+    }
+
+    # -- part 2: zipf churn soak, tier off vs on -----------------------------
+    # 16 tenants through 8 HBM slots: the spillover working set fits the
+    # 2.2x-slot tier budget, which is the sizing the knob is FOR — DRAM
+    # absorbs what HBM evicts. (With a tenant set far beyond HBM + tier the
+    # p95 tail is disk reloads in both arms and the tier only moves p50.)
+    tenants, cap, requests = 16, 8, 800
+    # widened CNN (~MBs of params per tenant) so the reload work the tier
+    # skips — artifact read + decode + pack — is measurable over timer noise
+    cnn_cfg = {"num_classes": 10, "width": 128}
+    # budget ~2x the HBM slot count in packed entries: probe one entry's size
+    probe_m, probe_rt = _make_stack(
+        "mnist_cnn", 1, os.path.join(tmp, "wt-probe"), config=cnn_cfg,
+        host_tier_bytes=1 << 30,
+    )
+    probe_m.ensure_servable(ModelId("tenant0", 1))
+    entry_bytes = probe_rt._host_tier.size_of(ModelId("tenant0", 1))
+    probe_m.close()
+    budget = int(2.2 * cap * entry_bytes)
+    churn: dict = {"tenants": tenants, "resident_cap": cap,
+                   "requests": requests,
+                   "host_tier_budget_mb": round(budget / (1 << 20), 1)}
+    out["churn"] = churn
+    for arm, tier_budget in (("off", 0), ("on", budget)):
+        m = Metrics()
+        manager, runtime = _make_stack(
+            "mnist_cnn", tenants, os.path.join(tmp, f"wt-churn-{arm}"),
+            config=cnn_cfg, resident_cap=cap, host_tier_bytes=tier_budget,
+            metrics=m,
+        )
+        inputs = _example_inputs("mnist_cnn", 1)
+        for i in range(tenants):  # cold sweep
+            tm = ModelId(f"tenant{i}", 1)
+            manager.ensure_servable(tm)
+            runtime.predict(tm, inputs)
+        rng = np.random.default_rng(7)  # SAME schedule both arms
+        ranks = np.minimum(rng.zipf(1.3, size=requests), tenants) - 1
+        miss_lat = []
+        for r in ranks:
+            tm = ModelId(f"tenant{int(r)}", 1)
+            warm = runtime.is_loaded(tm)
+            t0 = time.perf_counter()
+            manager.ensure_servable(tm)
+            runtime.predict(tm, inputs)
+            if not warm:
+                miss_lat.append(time.perf_counter() - t0)
+        sources = {
+            t: int(m.reload_source.labels(t)._value.get())
+            for t in ("hbm", "host", "disk", "store")
+        }
+        manager.close()
+        miss_lat.sort()
+        churn[arm] = {
+            "reloads": len(miss_lat),
+            "reload_p50_ms": round(miss_lat[len(miss_lat) // 2] * 1e3, 2),
+            "reload_p95_ms": round(
+                miss_lat[int(0.95 * (len(miss_lat) - 1))] * 1e3, 2
+            ),
+            "reload_source": sources,
+        }
+        if arm == "on":
+            total_reloads = max(len(miss_lat), 1)
+            churn[arm]["host_share_of_reloads"] = round(
+                sources["host"] / total_reloads, 3
+            )
+    churn["reload_p95_improvement"] = round(
+        churn["off"]["reload_p95_ms"] / max(churn["on"]["reload_p95_ms"], 1e-9),
+        2,
+    )
+    return out
+
+
 def _tiny_draft_cfg(lm_config: dict) -> dict:
     """Quarter-width independent draft preset (same vocab) — shared by the
     spec_decode and prefix_gen sections so their draft models never drift."""
@@ -1741,8 +1901,8 @@ def collect_watcher_evidence() -> dict:
     keep_sections = (
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
-        "continuous_batching", "zoo_cold", "cold_pipeline", "device_kind",
-        "chips", "only",
+        "continuous_batching", "zoo_cold", "warm_tier", "cold_pipeline",
+        "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
         if not fn.endswith(".json") or fn.endswith(".partial.json"):
@@ -2032,6 +2192,15 @@ def run(args) -> dict:
                 detail["tenant_soak"] = bench_tenant_soak(tmp)
         except Exception as e:  # noqa: BLE001
             detail["tenant_soak"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("warm_tier"):
+        try:
+            with _section("warm_tier"):
+                detail["warm_tier"] = bench_warm_tier(
+                    os.path.join(tmp, "warmtier")
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["warm_tier"] = {"error": f"{type(e).__name__}: {e}"}
 
     # LAST: this section calls jax.clear_caches() per arm, which would force
     # recompiles under any later section's measured window

@@ -242,3 +242,33 @@ async def test_p2c_candidates_prefer_less_loaded_replica():
     finally:
         await routing.close()
         await cluster.disconnect()
+
+
+async def test_p2c_equal_load_tie_breaks_on_residency_warmth():
+    """Equal in-flight counts fall back to residency warmth: the local
+    group that still holds the model (HBM or host tier) must lead every
+    time, while in-flight load keeps strict priority over warmth."""
+    mock = DiscoveryServiceMock()
+    cluster = ClusterConnection(mock, replicas_per_model=2)
+    self_node = NodeInfo("10.0.0.0", 9000, 9100)
+    connect = asyncio.create_task(cluster.connect(self_node, lambda: True, wait_ready_s=2))
+    await asyncio.sleep(0.05)
+    mock.push(nodes_list(2))
+    await connect
+    replicas = cluster.find_nodes_for_key("m##1")
+    warm, cold = replicas[0], replicas[1]
+    routing = RoutingBackend(
+        cluster,
+        local_warmth={warm.ident: lambda mid: 2},  # host-tier resident
+    )
+    try:
+        # equal load (zero everywhere): warmth decides, deterministically
+        for _ in range(12):
+            assert routing._candidates("m", 1)[0].ident == warm.ident
+        # load still dominates: the warm node carrying work loses the tie
+        routing._inflight_inc(warm.ident)
+        for _ in range(12):
+            assert routing._candidates("m", 1)[0].ident == cold.ident
+    finally:
+        await routing.close()
+        await cluster.disconnect()

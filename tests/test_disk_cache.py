@@ -50,6 +50,45 @@ def test_recover_index_after_restart(tmp_path):
     assert got is not None and os.path.isdir(got.path)
 
 
+def test_get_touches_recency(tmp_path):
+    """Reads must promote to MRU: after touching the oldest entry, an
+    over-budget put evicts the *next* least-recent model, not the touched
+    one. (A touch=False regression here silently turns the LRU into FIFO —
+    exactly what the hot tail of a churned tenant set can't survive.)"""
+    cache = ModelDiskCache(str(tmp_path / "c"), capacity_bytes=250)
+    a, b, c = ModelId("a", 1), ModelId("b", 1), ModelId("c", 1)
+    cache.put(write_artifact(cache, a, 100))
+    cache.put(write_artifact(cache, b, 100))
+    assert cache.get(a) is not None  # a becomes MRU; b is now the victim
+    cache.put(write_artifact(cache, c, 100))
+    cache.drain_evictions()
+    assert cache.get(b) is None
+    assert cache.get(a) is not None and cache.get(c) is not None
+
+
+def test_put_charges_actual_bytes_on_disk(tmp_path):
+    """Eviction accounting must match reality: a provider-claimed size that
+    drifts from the written tree is corrected at put() time, so the byte
+    budget reflects what the disk actually holds."""
+    cache = ModelDiskCache(str(tmp_path / "c"), capacity_bytes=700)
+    mid = ModelId("drift", 1)
+    model = write_artifact(cache, mid, 300)
+    model.size_on_disk = 10  # the lie a stale manifest would tell
+    cache.put(model)
+    assert cache.total_bytes == 300
+    assert cache.size_of(mid) == 300
+    # and the budget enforces against the corrected number: two more real
+    # 300-byte artifacts push the first out despite claimed tiny sizes
+    # (3 x "10 claimed" would all fit; 3 x 300 actual cannot)
+    for name in ("d2", "d3"):
+        m = write_artifact(cache, ModelId(name, 1), 300)
+        m.size_on_disk = 10
+        cache.put(m)
+    cache.drain_evictions()
+    assert cache.total_bytes == 600
+    assert cache.get(mid) is None  # LRU victim of the corrected accounting
+
+
 def test_replace_put_does_not_delete_new_artifact(tmp_path):
     # Disk-tier replacement: same key, same path — the overwrite already
     # happened in place; the replace-callback must not rmtree the new files.

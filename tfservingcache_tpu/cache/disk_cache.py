@@ -90,7 +90,11 @@ class ModelDiskCache:
 
     # -- LRU facade ---------------------------------------------------------
     def get(self, model_id: ModelId) -> Model | None:
-        model = self.lru.get(model_id)
+        # a read IS a use: touch to MRU so the hot tail of a churned tenant
+        # population survives eviction pressure (recency pinned by
+        # tests/test_disk_cache.py — a silent touch=False regression here
+        # turns the LRU into FIFO)
+        model = self.lru.get(model_id, touch=True)
         if model is None:
             return None
         # Tolerate out-of-band deletion: index says cached but files are gone
@@ -101,6 +105,17 @@ class ModelDiskCache:
         return model
 
     def put(self, model: Model) -> list[ModelId]:
+        # charge what is ACTUALLY on disk, not what the provider claimed:
+        # a drifted size_on_disk (manifest lies, partial rewrite, compression
+        # difference) would otherwise skew the byte budget until restart
+        if os.path.isdir(model.path):
+            actual = dir_size_bytes(model.path)
+            if actual != model.size_on_disk:
+                log.warning(
+                    "size drift for %s: claimed %d bytes, %d on disk",
+                    model.identifier, model.size_on_disk, actual,
+                )
+                model.size_on_disk = actual
         return self.lru.put(model.identifier, model.size_on_disk, model)
 
     def ensure_free_bytes(self, n: int) -> list[ModelId]:

@@ -1,0 +1,140 @@
+"""Host-RAM warm tier: byte-budgeted LRU of packed parameter chunks.
+
+The middle tier of the three-tier residency model (HBM -> host DRAM ->
+disk/store). Where the disk tier retains the *encoded* artifact (bytes the
+loader still has to parse, possibly dequantize, and repack), this tier
+retains per model exactly what the H2D transfer consumes: the already-
+decoded, already-quantized, concatenated chunk buffers in
+``_pack_plan`` order, plus the runtime's jitted/AOT executable handles —
+so promotion back into HBM is a pure ``device_put`` replay with no
+provider fetch and no host decode (runtime/model_runtime.py).
+
+Tier discipline is inclusive downward: a host-tier entry implies the
+artifact is still on disk (CacheManager discards the entry when the disk
+tier evicts the artifact), so "resident => re-loadable" keeps holding at
+every level. Same LRU engine as the other two tiers (native/lru.py via
+``make_lru_cache``): byte budget, MRU touch on get, evict callbacks run
+outside the internal lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from tfservingcache_tpu.cache.lru import LRUEntry
+from tfservingcache_tpu.native import make_lru_cache
+from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.metrics import Metrics
+
+log = get_logger("host_tier")
+
+
+@dataclass
+class PackedModelEntry:
+    """One evicted (or eagerly retained) model's transfer-ready state.
+
+    ``chunks`` are OWNED host buffers (never views into an mmapped artifact
+    blob — retaining a view would pin the whole file mapping), one per
+    ``_pack_plan`` chunk, in plan order. ``owner``/``shapes``/
+    ``quant_dtypes`` describe how the flat buffers re-slice into the outer
+    leaf list, mirroring ``packed_device_put_pipelined``'s bookkeeping so
+    promotion replays the identical device-op sequence. ``jitted`` keeps
+    the family's jax.jit handle alive: jit's dispatch cache lives on the
+    function object, so a promoted model's first predict is a cache hit
+    even when the last HBM tenant of the family was evicted in between.
+    """
+
+    model_def: Any
+    chunks: list[tuple[list[int], np.ndarray]]
+    owner: list[tuple[int, str]]          # flat idx -> (outer idx, plain|q|scale)
+    shapes: list[tuple[int, ...]]         # flat idx -> leaf shape
+    quant_dtypes: dict[int, str]          # outer idx -> orig_dtype (quant leaves)
+    treedef: Any                          # outer flatten, QuantLeaf as leaf
+    jitted: Any
+    aot_entries: dict = field(default_factory=dict)
+    hbm_bytes: int = 0
+    nbytes: int = 0
+
+
+class HostRamTier:
+    """Thread-safe byte-budgeted LRU of ``PackedModelEntry``.
+
+    Thin facade over the shared LRU engine (the tier-interface twin of
+    ``ModelDiskCache``: get touches to MRU, put evicts LRU-first to fit,
+    callbacks run after the internal lock is released) plus the tier's
+    metrics: ``tpusc_host_tier_bytes`` gauge and
+    ``tpusc_evictions_total{tier="host"}``.
+    """
+
+    def __init__(self, capacity_bytes: int, metrics: Metrics | None = None) -> None:
+        self.metrics = metrics
+        self.lru = make_lru_cache(int(capacity_bytes), self._on_evict)
+        self._closed = threading.Event()
+
+    # -- LRU facade ---------------------------------------------------------
+    def get(self, model_id: ModelId, touch: bool = True) -> PackedModelEntry | None:
+        return self.lru.get(model_id, touch=touch)
+
+    def put(self, model_id: ModelId, entry: PackedModelEntry) -> list[ModelId]:
+        if self._closed.is_set():
+            return []
+        evicted = self.lru.put(model_id, entry.nbytes, entry)
+        self._update_gauge()
+        return evicted
+
+    def touch(self, model_id: ModelId) -> bool:
+        """MRU-promote without materializing the payload; True if present."""
+        return self.lru.get(model_id) is not None
+
+    def remove(self, model_id: ModelId) -> None:
+        self.lru.remove(model_id, run_callback=False)
+        self._update_gauge()
+
+    def __contains__(self, model_id: ModelId) -> bool:
+        return model_id in self.lru
+
+    def __len__(self) -> int:
+        return len(self.lru)
+
+    def keys_mru_first(self) -> list[ModelId]:
+        return self.lru.keys_mru_first()
+
+    def size_of(self, model_id: ModelId) -> int | None:
+        entry = self.lru.get(model_id, touch=False)
+        return None if entry is None else entry.nbytes
+
+    @property
+    def total_bytes(self) -> int:
+        return self.lru.total_bytes
+
+    @property
+    def capacity_bytes(self) -> int:
+        return self.lru.capacity_bytes
+
+    # -- internals ----------------------------------------------------------
+    def _on_evict(self, model_id: ModelId, entry: LRUEntry[PackedModelEntry]) -> None:
+        # dropping the references IS the free: chunks are plain host arrays
+        if self.metrics is not None:
+            self.metrics.evictions.labels("host").inc()
+        self._update_gauge()
+        log.info(
+            "host tier evicted %s (%d packed bytes)", model_id, entry.size_bytes
+        )
+
+    def _update_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.host_tier_bytes.set(self.lru.total_bytes)
+
+    def clear(self) -> None:
+        self.lru.clear()
+        self._update_gauge()
+
+    def close(self) -> None:
+        self._closed.set()
+        self.lru.clear()
+        self._update_gauge()

@@ -90,7 +90,14 @@ class CacheManager:
         disk_cache.add_evict_callback(self._on_disk_evict)
 
     def _on_disk_evict(self, model_id: ModelId) -> None:
-        self.runtime.unload(model_id)
+        # unload_and_discard (not plain unload): the host tier is inclusive
+        # in the disk tier, so an evicted artifact takes any retained packed
+        # chunks down with it (duck-typed for runtimes without the method)
+        discard = getattr(self.runtime, "unload_and_discard", None)
+        if discard is not None:
+            discard(model_id)
+        else:
+            self.runtime.unload(model_id)
 
     # ------------------------------------------------------------------
     def ensure_servable(self, model_id: ModelId) -> Model:
@@ -109,26 +116,32 @@ class CacheManager:
         if model is not None and self.runtime.is_loaded(model_id):
             if self.metrics is not None:
                 self.metrics.cache_hits.labels(label).inc()
+                self.metrics.reload_source.labels("hbm").inc()
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
             return model
 
         deadline = t0 + self.load_timeout_s if self.load_timeout_s else None
-        with TRACER.span("ensure_servable", model=str(model_id)), \
+        with TRACER.span("ensure_servable", model=str(model_id)) as span, \
                 self.disk_cache.fetch_lock(model_id):  # per-model singleflight
             model = self.disk_cache.get(model_id)
             if model is not None:
                 if self.runtime.is_loaded(model_id):
                     hit = True  # another waiter finished the work
+                    source = "hbm"
                 else:
-                    # STALE: artifact cached, executable not resident
+                    # STALE: artifact cached, executable not resident — the
+                    # runtime reports which tier actually revived it (host
+                    # promotion vs full disk load; None = plain runtime)
                     log.info("stale %s: artifact cached, reloading runtime", model_id)
-                    self._with_deadline(
+                    src = self._with_deadline(
                         lambda: self.runtime.ensure_loaded(model), deadline,
                         f"reload {model_id}",
                     )
                     hit = True
+                    source = src if src in ("hbm", "host") else "disk"
             else:
                 hit = False
+                source = "store"
                 model = self._with_deadline(
                     lambda: self._fetch(model_id), deadline, f"fetch {model_id}"
                 )
@@ -136,13 +149,35 @@ class CacheManager:
                     lambda: self.runtime.ensure_loaded(model), deadline,
                     f"load {model_id}",
                 )
+            span.attrs["reload_source"] = source
             if self.metrics is not None:
                 (self.metrics.cache_hits if hit else self.metrics.cache_misses).labels(
                     label
                 ).inc()
+                self.metrics.reload_source.labels(source).inc()
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
                 self.metrics.disk_bytes_in_use.set(self.disk_cache.total_bytes)
             return model
+
+    def residency_warmth(self, model_id: ModelId) -> int:
+        """How warm is ``model_id`` on THIS node: 3 = HBM-resident,
+        2 = host-tier packed (promotable in tens of ms), 1 = disk artifact,
+        0 = cold. Advisory snapshot for the router's equal-load tie-break
+        (cluster/router.py): a replica that can promote instead of
+        refetching should win ties. Never raises — routing must not fail
+        on a warmth probe."""
+        try:
+            if self.runtime.is_loaded(model_id):
+                return 3
+            contains = getattr(self.runtime, "host_tier_contains", None)
+            if contains is not None and contains(model_id):
+                return 2
+            # size_of, not get: a warmth probe must not perturb LRU recency
+            if self.disk_cache.size_of(model_id) is not None:
+                return 1
+        except Exception:  # noqa: BLE001 - advisory only
+            pass
+        return 0
 
     def _with_deadline(self, fn, deadline: float | None, desc: str):
         """Run ``fn`` under the shared cold-load deadline.

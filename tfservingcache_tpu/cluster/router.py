@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import random
-from typing import Mapping
+from typing import Callable, Mapping
 
 import aiohttp
 import grpc
@@ -98,9 +98,19 @@ class RoutingBackend(ServingBackend):
         max_message_bytes: int = 16 << 20,
         retries: int = 2,
         version_labels: Mapping[str, Mapping[str, int]] | None = None,
+        local_warmth: Mapping[str, Callable[[ModelId], int]] | None = None,
     ) -> None:
         self.cluster = cluster
         self.local_backends: dict[str, ServingBackend] = dict(local_backends or {})
+        # ident -> residency-warmth probe (CacheManager.residency_warmth) for
+        # the chip groups served IN THIS PROCESS. Peers don't advertise cache
+        # state over the ring (membership-only discovery), so warmth can only
+        # break p2c ties toward a local group that still holds the model in
+        # HBM or the host tier; a future cache-state advertisement would
+        # extend this map to remote idents without touching _candidates.
+        self.local_warmth: dict[str, Callable[[ModelId], int]] = dict(
+            local_warmth or {}
+        )
         self.pool = PeerPool(max_message_bytes)
         self.retries = retries
         # the ring routes by name##version, so a version_label must resolve
@@ -154,8 +164,12 @@ class RoutingBackend(ServingBackend):
         the rest as the failover rotation. Uniform-random pick of 2 + least
         loaded avoids both the herd of global-least-loaded and the variance
         of plain random (a slow peer — long :generate, cold compile — keeps
-        collecting new work under pure random rotation)."""
-        key = ModelId(name, int(version or 0)).key
+        collecting new work under pure random rotation). Equal in-flight
+        counts fall back to residency warmth (HBM > host tier > disk >
+        cold) so a replica that can promote from its warm tier beats one
+        that must refetch — cache state breaks the tie, load decides."""
+        mid = ModelId(name, int(version or 0))
+        key = mid.key
         nodes = self.cluster.find_nodes_for_key(key)
         if not nodes:
             raise BackendError(
@@ -166,8 +180,22 @@ class RoutingBackend(ServingBackend):
         i, j = random.sample(range(len(nodes)), 2)
         load_i = self._inflight.get(nodes[i].ident, 0)
         load_j = self._inflight.get(nodes[j].ident, 0)
-        start = i if load_i <= load_j else j
+        if load_i == load_j and self.local_warmth:
+            start = i if self._warmth(nodes[i].ident, mid) >= self._warmth(
+                nodes[j].ident, mid
+            ) else j
+        else:
+            start = i if load_i <= load_j else j
         return nodes[start:] + nodes[:start]
+
+    def _warmth(self, ident: str, model_id: ModelId) -> int:
+        fn = self.local_warmth.get(ident)
+        if fn is None:
+            return 0  # no probe (remote peer): assume cold
+        try:
+            return int(fn(model_id))
+        except Exception:  # noqa: BLE001 - advisory, never fail routing
+            return 0
 
     async def _forward_grpc(self, service: str, method: str, name: str, version, request):
         last_err: Exception | None = None
@@ -374,16 +402,22 @@ class Router:
             local_backends = {
                 n.ident: g.backend for n, g in zip(self.self_nodes, node.groups)
             }
+            local_warmth = {
+                n.ident: g.manager.residency_warmth
+                for n, g in zip(self.self_nodes, node.groups)
+            }
         else:
             self.self_nodes = [
                 NodeInfo(host, cfg.cache_node.rest_port, cfg.cache_node.grpc_port)
             ]
             local_backends = {}
+            local_warmth = {}
         self.backend = RoutingBackend(
             self.cluster,
             local_backends,
             cfg.proxy.grpc_max_message_bytes,
             version_labels=cfg.serving.version_labels,
+            local_warmth=local_warmth,
         )
         metrics = node.metrics if node is not None else None
         self.rest = RestServingServer(

@@ -99,10 +99,18 @@ class ServingConfig:
 
 @dataclass
 class CacheConfig:
-    """Disk artifact cache (reference config.yaml:25-27)."""
+    """Disk artifact cache (reference config.yaml:25-27) plus the host-RAM
+    warm tier that sits between it and the HBM slots."""
 
     base_dir: str = "/tmp/tpusc_models"
     disk_capacity_bytes: int = 10 << 30
+    # Host-RAM warm tier (cache/host_tier.py): byte budget of host DRAM for
+    # retaining evicted models' already-decoded, pre-packed transfer chunks
+    # plus their executable handles, so re-admission skips provider fetch
+    # and host decode entirely and pays only the H2D stream. 0 = off
+    # (default — identical to the two-tier behavior). Mesh/multi-process
+    # runtimes ignore it and always take the full load path.
+    host_tier_bytes: int = 0
 
 
 @dataclass

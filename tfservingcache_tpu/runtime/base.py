@@ -71,9 +71,12 @@ class BaseRuntime(abc.ABC):
 
     # -- core ---------------------------------------------------------------
     @abc.abstractmethod
-    def ensure_loaded(self, model: Model) -> None:
+    def ensure_loaded(self, model: Model) -> str | None:
         """Make ``model`` servable (idempotent); blocks until AVAILABLE or
-        raises. The artifact is already on local disk at ``model.path``."""
+        raises. The artifact is already on local disk at ``model.path``.
+        May return the residency tier that served the call ("hbm" | "host"
+        | "disk") for the ``tpusc_reload_source`` accounting; a ``None``
+        return is read as a full disk load."""
 
     @abc.abstractmethod
     def is_loaded(self, model_id: ModelId) -> bool: ...
@@ -88,6 +91,13 @@ class BaseRuntime(abc.ABC):
 
     @abc.abstractmethod
     def unload(self, model_id: ModelId) -> None: ...
+
+    def unload_and_discard(self, model_id: ModelId) -> None:
+        """Disk-evict hook: drop HBM residency AND any intermediate-tier
+        state (host-RAM packed chunks) so no tier retains a model whose
+        backing artifact is gone. Runtimes without extra tiers inherit the
+        plain unload."""
+        self.unload(model_id)
 
     @abc.abstractmethod
     def signature(self, model_id: ModelId) -> tuple[dict[str, TensorSpec], dict[str, TensorSpec], str]:

@@ -133,7 +133,12 @@ class CacheNode:
                         self._follower_managers.append(mgr)
                     self.work_server = GroupWorkServer(self.work_handler)
             else:
-                runtimes = [(0, TPUModelRuntime(cfg.serving, self.metrics))]
+                # host tier is single-chip only (mesh runtimes above keep the
+                # deterministic full-load path, so the knob is not plumbed)
+                runtimes = [(0, TPUModelRuntime(
+                    cfg.serving, self.metrics,
+                    host_tier_bytes=cfg.cache.host_tier_bytes,
+                ))]
 
         self.groups: list[ServingGroup] = []
         for pos, (i, rt) in enumerate(runtimes):

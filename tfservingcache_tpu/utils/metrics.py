@@ -116,6 +116,22 @@ class Metrics:
         self.evictions = Counter(
             "tpusc_evictions_total", "Evictions", ["tier"], registry=r
         )
+        # multi-tier residency observability (cache/host_tier.py): which
+        # tier satisfied each ensure_servable — hbm = already warm, host =
+        # packed-chunk promotion (no fetch, no decode), disk = artifact
+        # re-read + full load, store = provider fetch. The mix is the
+        # direct answer to "what are my reloads costing".
+        self.reload_source = Counter(
+            "tpusc_reload_source",
+            "ensure_servable resolutions by serving tier "
+            "(tier = hbm | host | disk | store)",
+            ["tier"], registry=r,
+        )
+        self.host_tier_bytes = Gauge(
+            "tpusc_host_tier_bytes",
+            "Host DRAM held by the warm tier's packed parameter chunks",
+            registry=r,
+        )
         # continuous batching observability: how often requests coalesce and
         # how many ride each device call (kind = predict | generate)
         self.coalesced_batches = Counter(
