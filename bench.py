@@ -277,7 +277,7 @@ SECTION_GROUPS = (
     "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
-    "warm_tier", "cold_pipeline",
+    "warm_tier", "cold_pipeline", "paged_kv",
 )
 
 
@@ -1838,6 +1838,140 @@ def bench_continuous_batching(tmp: str, lm_config: dict) -> dict:
     return out
 
 
+def bench_paged_kv(tmp: str, lm_config: dict) -> dict:
+    """Dense vs paged KV at the SAME KV-byte budget on the same seeded
+    mixed-length Poisson schedule. The dense arm spends the budget as 4
+    worst-case lanes (each reserves max_seq rows whatever the request
+    needs); the paged arm spends the identical bytes as a page arena and
+    admits by actual prompt + max_new budget, so many short rows fit where
+    4 dense lanes did. Reported per arm: peak admitted concurrent slots
+    (the acceptance headline), p50/p95 TTFT, tok/s. Both arms run the
+    continuous engine — this isolates the memory model, not the
+    scheduler."""
+    import threading
+
+    import numpy as np
+
+    from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    manager, runtime = _make_stack("transformer_lm", 1, tmp, config=lm_config)
+    mid = ModelId("tenant0", 1)
+    manager.ensure_servable(mid)
+
+    dense_slots, chunk = 4, 4
+    page_tokens = 16
+    max_seq = int(lm_config["max_seq"])
+    # identical KV bytes: the dense arm's 4 x max_seq rows, re-cut as pages
+    arena_pages = dense_slots * (max_seq // page_tokens)
+    paged_slots = 16  # lane cap (compile width); pages are the real gate
+    head_dim = lm_config["d_model"] // lm_config["n_heads"]
+    bytes_per_token = (
+        2 * lm_config["n_layers"] * lm_config["n_kv_heads"] * head_dim
+        * np.dtype(lm_config.get("dtype", "float32")).itemsize
+    )
+
+    n_req = 24
+    vocab = lm_config["vocab_size"]
+    r = np.random.default_rng(42)
+    reqs = [
+        (
+            r.integers(0, vocab, int(r.integers(8, 17))).astype(np.int32),
+            int(r.integers(4, 33)),
+        )
+        for _ in range(n_req)
+    ]
+    arrivals = np.cumsum(r.exponential(0.02, n_req))
+
+    def replay(gen_fn) -> tuple[list, float]:
+        results: list = [None] * n_req
+        errors: list = []
+
+        def client(i):
+            prompt, max_new = reqs[i]
+            try:
+                results[i] = gen_fn(prompt, max_new)
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        start = time.perf_counter()
+        for i in range(n_req):
+            delay = arrivals[i] - (time.perf_counter() - start)
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        if errors:
+            raise RuntimeError(f"{len(errors)} failed: {errors[:3]}")
+        return results, wall
+
+    def run_arm(**engine_kw) -> dict:
+        metrics = Metrics()
+        eng = ContinuousGenerateEngine(
+            runtime, chunk_tokens=chunk, metrics=metrics, **engine_kw
+        )
+        try:
+            # warm the compiled prefill/insert/chunk programs off-window
+            eng.generate(mid, np.ones((1, 16), np.int32), max_new_tokens=4)
+            eng.peak_active = 0
+
+            def fn(prompt, max_new):
+                _, stats = eng.generate(
+                    mid, prompt[None], max_new_tokens=max_new,
+                    return_stats=True,
+                )
+                return stats[0]["ttft_s"], stats[0]["tokens"]
+
+            results, wall = replay(fn)
+            ttfts = sorted(t for t, _ in results)
+            toks = sum(n for _, n in results)
+            out = {
+                "peak_admitted_slots": eng.peak_active,
+                "p50_ttft_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+                "p95_ttft_ms": round(
+                    ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] * 1e3,
+                    1,
+                ),
+                "tok_s": round(toks / wall, 1),
+                "wall_s": round(wall, 2),
+                "tokens": toks,
+            }
+            waste = metrics.registry.get_sample_value(
+                "tpusc_gen_kv_page_waste_tokens_sum"
+            )
+            if waste is not None and waste > 0:
+                out["page_waste_tokens"] = int(waste)
+            return out
+        finally:
+            eng.close()
+            runtime.drop_slot_state(mid)  # next arm allocates its own layout
+
+    out = {
+        "requests": n_req,
+        "kv_budget_bytes": dense_slots * max_seq * int(bytes_per_token),
+        "kv_bytes_per_token": int(bytes_per_token),
+        "page_tokens": page_tokens,
+        "arena_pages": arena_pages,
+        "dense": run_arm(slots=dense_slots),
+        "paged": run_arm(
+            slots=paged_slots, page_tokens=page_tokens,
+            arena_pages=arena_pages,
+        ),
+    }
+    out["admitted_slots_ratio"] = round(
+        out["paged"]["peak_admitted_slots"]
+        / max(1, out["dense"]["peak_admitted_slots"]), 2
+    )
+    manager.close()
+    return out
+
+
 def watcher_liveness() -> dict:
     """Probe-history summary from the watcher's state file + log, embedded
     into EVERY bench artifact — even a CPU-fallback run self-reports whether
@@ -1902,6 +2036,7 @@ def collect_watcher_evidence() -> dict:
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
         "continuous_batching", "zoo_cold", "warm_tier", "cold_pipeline",
+        "paged_kv",
         "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
@@ -2212,6 +2347,15 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["cold_pipeline"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("paged_kv"):
+        try:
+            with _section("paged_kv"):
+                detail["paged_kv"] = bench_paged_kv(
+                    os.path.join(tmp, "pagedkv"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["paged_kv"] = {"error": f"{type(e).__name__}: {e}"}
 
     _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):

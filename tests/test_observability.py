@@ -454,12 +454,17 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_disk_cache_bytes_in_use",
     "tpusc_evictions",
     "tpusc_gen_admission_wait_seconds",
+    "tpusc_gen_kv_page_waste_tokens",
+    "tpusc_gen_kv_pages_total",
+    "tpusc_gen_kv_pages_used",
     "tpusc_gen_slots_active",
     "tpusc_gen_wasted_steps",
     "tpusc_group_healthy",
     "tpusc_group_reform_events",
     "tpusc_hbm_bytes_in_use",
+    "tpusc_host_tier_bytes",
     "tpusc_models_resident",
+    "tpusc_reload_source",
     "tpusc_prefix_cache_bytes",
     "tpusc_prefix_cache_hits",
     "tpusc_prefix_cache_misses",

@@ -49,6 +49,14 @@ from tfservingcache_tpu.utils.tracing import TRACER, format_traceparent
 
 log = get_logger("router")
 
+# Forwarded-REST connection pool (see _http_session). Sized for a ring of
+# cache nodes, not the open internet: a handful of stable peers, each
+# bounded so one slow peer can't monopolize the pool.
+HTTP_POOL_LIMIT = 128            # total pooled connections across all peers
+HTTP_POOL_LIMIT_PER_HOST = 32    # cap per peer
+HTTP_KEEPALIVE_S = 30.0          # idle keepalive >> typical inter-request gap
+HTTP_DNS_TTL_S = 10.0            # re-resolve re-scheduled peers within ~10 s
+
 
 class PeerPool:
     """Per-peer gRPC channel cache (reference grpcConnMap,
@@ -143,8 +151,23 @@ class RoutingBackend(ServingBackend):
         return spec.version.value
 
     def _http_session(self) -> aiohttp.ClientSession:
+        """Lazily-built session for forwarded REST calls. The connector is
+        explicit rather than aiohttp's defaults: forwarded hot paths hit a
+        small, stable set of ring peers over and over, so per-host pooling
+        with a generous keepalive is what makes forwarding pay one TCP/TLS
+        handshake per peer instead of per request — and a bounded
+        limit_per_host keeps a slow peer from absorbing every connection in
+        the pool. The short DNS cache amortizes resolution without pinning
+        a re-scheduled peer's old address for long."""
         if self._http is None or self._http.closed:
-            self._http = aiohttp.ClientSession()
+            self._http = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(
+                    limit=HTTP_POOL_LIMIT,
+                    limit_per_host=HTTP_POOL_LIMIT_PER_HOST,
+                    keepalive_timeout=HTTP_KEEPALIVE_S,
+                    ttl_dns_cache=HTTP_DNS_TTL_S,
+                )
+            )
         return self._http
 
     # -- routing core -------------------------------------------------------

@@ -88,6 +88,18 @@ class ServingConfig:
     # the cost of up to k-1 overshoot steps per finishing row (PERF.md
     # "Continuous batching" discusses the tradeoff).
     generate_chunk_tokens: int = 8
+    # Paged KV for the continuous engine. 0 (default) keeps the dense
+    # per-lane slot array (slots x max_seq rows reserved per lane). > 0
+    # replaces it with a shared page arena: fixed pages of kv_page_tokens
+    # tokens each, handed out by a free-list at admission (the row's full
+    # prompt + max_new budget is pre-reserved) and recycled at retirement,
+    # so HBM is sized by tokens in flight instead of worst case.
+    kv_page_tokens: int = 0
+    # Usable arena pages (one extra trash page is always added). 0 = auto:
+    # generate_slots x ceil(max_seq / kv_page_tokens) — the dense-equivalent
+    # byte budget; shrink it to cap KV HBM, grow it (with generate_slots) to
+    # admit more concurrent rows at the same budget.
+    kv_arena_pages: int = 0
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

@@ -355,6 +355,151 @@ def _decode_chunk_jit(
     return slot_k, slot_v, tok, pos, jnp.transpose(toks, (1, 0))  # (S, chunk)
 
 
+def init_paged_cache(cfg: dict, n_pages: int, page_tokens: int) -> dict:
+    """Preallocated paged KV arena shared by every lane of one model's
+    continuous-decode state: fixed-size pages instead of per-lane
+    ``max_seq`` rows, so HBM is sized by tokens in flight, not worst case.
+    Page 0 is the TRASH page — never handed out by the free-list; retired
+    and never-admitted lanes' block tables point at it so their frozen
+    rewrites land somewhere no live lane ever gathers."""
+    n_kv = cfg["n_kv_heads"]
+    head_dim = cfg["d_model"] // cfg["n_heads"]
+    dtype = jnp.dtype(cfg["dtype"])
+    shape = (cfg["n_layers"], n_pages, n_kv, page_tokens, head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
+                        page_tokens: int):
+    """One decode step (s_len=1 per lane) against the paged arena — the
+    block-table counterpart of ``_forward_cached_dyn``. Each lane writes its
+    new K/V at ``tables[lane, pos // page_tokens]`` offset ``pos %
+    page_tokens`` (clipped to the last table slot: overshoot past a lane's
+    reservation hits a zeroed table entry, i.e. the trash page), then
+    attends over its gathered pages with the identical GQA einsum/mask
+    pipeline as the dense path — same shapes, same reduction order, so
+    greedy decode is token-for-token identical."""
+    from tfservingcache_tpu.ops.attention import paged_decode_attention
+
+    dtype = jnp.dtype(cfg["dtype"])
+    s_lanes = tok.shape[0]
+    n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
+    head_dim = cfg["d_model"] // n_heads
+    pps = tables.shape[1]
+    positions = pos[:, None]                                     # (S, 1)
+    page = jnp.take_along_axis(
+        tables, jnp.clip(pos // page_tokens, 0, pps - 1)[:, None], axis=1
+    )[:, 0]                                                      # (S,)
+    off = pos % page_tokens
+
+    x = params["embed"][tok[:, None]].astype(dtype)              # (S, 1, d)
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        attn = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["attn"])
+        h = _rmsnorm(x, layer["ln1"])
+        q = (h @ attn["wq"]).reshape(s_lanes, 1, n_heads, head_dim).transpose(0, 2, 1, 3)
+        k = (h @ attn["wk"]).reshape(s_lanes, 1, n_kv, head_dim).transpose(0, 2, 1, 3)
+        v = (h @ attn["wv"]).reshape(s_lanes, 1, n_kv, head_dim).transpose(0, 2, 1, 3)
+        q = _rope_per_example(q, positions, cfg["rope_theta"])
+        k = _rope_per_example(k, positions, cfg["rope_theta"])
+
+        # scatter each lane's single new row into its current page; lanes
+        # parked on the trash page may collide — last-writer-wins junk that
+        # no live lane's block table can reach
+        k_arena = cache["k"][li].at[page, :, off, :].set(
+            k[:, :, 0, :].astype(cache["k"].dtype)
+        )
+        v_arena = cache["v"][li].at[page, :, off, :].set(
+            v[:, :, 0, :].astype(cache["v"].dtype)
+        )
+        new_k.append(k_arena)
+        new_v.append(v_arena)
+
+        out = paged_decode_attention(q, k_arena, v_arena, tables, pos,
+                                     page_tokens)
+        out = out.reshape(s_lanes, n_heads, 1, head_dim).astype(x.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(s_lanes, 1, cfg["d_model"])
+        x = x + out @ attn["wo"]
+        x = x + _ffn_block(layer, x, cfg, family, dtype)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("page_tokens",)
+)
+def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, *, page_tokens):
+    """Scatter one admitted request's prefill K/V (layers, 1, n_kv, P_pad,
+    hd) into its reserved pages: logical row ``r`` goes to page
+    ``table_row[r // page_tokens]`` offset ``r % page_tokens``. ``table_row``
+    is the lane's FULL (pages_per_slot,) block-table row — entries beyond
+    the reservation are 0, so prefill-pad rows past the reserved budget
+    (P_pad is a pow2 bucket and can overshoot it) land in the trash page.
+    Junk pad rows inside the reservation are never visible for the same
+    write-before-read reason as the dense insert. One compile per P_pad
+    bucket, same bound as the prefill itself."""
+    p_pad = pk.shape[3]
+    pps = table_row.shape[0]
+    rows = jnp.arange(p_pad)
+    pages = table_row[jnp.clip(rows // page_tokens, 0, pps - 1)]  # (P_pad,)
+    offs = rows % page_tokens
+    # (layers, 1, n_kv, P_pad, hd) -> (P_pad, layers, n_kv, hd): the two
+    # advanced indices below are non-adjacent, so their broadcast dim moves
+    # to the front of the updated slice
+    kv = pk[:, 0].transpose(2, 0, 1, 3)
+    vv = pv[:, 0].transpose(2, 0, 1, 3)
+    arena_k = arena_k.at[:, pages, :, offs, :].set(kv.astype(arena_k.dtype))
+    arena_v = arena_v.at[:, pages, :, offs, :].set(vv.astype(arena_v.dtype))
+    return arena_k, arena_v
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg_key", "family", "chunk", "page_tokens"),
+    donate_argnums=(1, 2),
+)
+def _paged_decode_chunk_jit(
+    params,
+    arena_k,             # (layers, n_pages, n_kv, page_tokens, hd) — donated
+    arena_v,
+    tables,              # (S, pages_per_slot) i32 block tables
+    tok,                 # (S,) last sampled token per lane
+    pos,                 # (S,) i32 write position per lane
+    active,              # (S,) bool — frozen for the whole chunk
+    rngs,                # (chunk, 2) uint32 — one PRNG key per step
+    temperature,         # (S,) f32 per-lane
+    top_k,               # (S,) i32 per-lane
+    *,
+    cfg_key,
+    family: str = "transformer_lm",
+    chunk: int,
+    page_tokens: int,
+):
+    """Paged counterpart of ``_decode_chunk_jit``: same scan, same frozen
+    inactive-lane convention, but K/V live in the shared page arena and
+    each lane reads through its block table. ``tables`` is traced (a tiny
+    (S, pages_per_slot) i32 H2D copy per chunk), so recycling pages never
+    mints a new program; compiled-program count stays one per chunk size."""
+    cfg = dict(cfg_key)
+
+    def step(carry, rng):
+        k, v, tok, pos = carry
+        logits, cache = _paged_forward_step(
+            params, tok, {"k": k, "v": v}, tables, pos, cfg, family,
+            page_tokens,
+        )
+        nxt = _sample_per_row(logits[:, 0], rng, temperature, top_k)
+        nxt = jnp.where(active, nxt, tok)
+        pos = pos + active.astype(jnp.int32)
+        return (cache["k"], cache["v"], nxt, pos), nxt
+
+    (arena_k, arena_v, tok, pos), toks = jax.lax.scan(
+        step, (arena_k, arena_v, tok, pos), rngs, length=chunk
+    )
+    return arena_k, arena_v, tok, pos, jnp.transpose(toks, (1, 0))  # (S, chunk)
+
+
 def _ffn_block(layer: dict, x, cfg: dict, family: str, dtype):
     """The family-specific second half of a decoder layer (input is the
     residual stream BEFORE its norm; returns the residual delta)."""

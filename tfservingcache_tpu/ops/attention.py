@@ -474,6 +474,79 @@ def flash_attention_carry(
     )
 
 
+# ---------------------------------------------------------------------------
+# Paged-KV attention (continuous decode engine)
+# ---------------------------------------------------------------------------
+
+def paged_gather_kv(
+    pages: jax.Array, tables: jax.Array, page_tokens: int
+) -> jax.Array:
+    """Assemble each lane's logical K or V row from the shared page arena.
+
+    ``pages`` is the arena ``(n_pages, Hkv, page_tokens, D)``; ``tables``
+    is the per-lane block table ``(S, pages_per_slot)`` of page indices.
+    Logical position ``p`` of lane ``s`` lives at
+    ``pages[tables[s, p // page_tokens], :, p % page_tokens]`` — the gather
+    lays pages out in block-table order, so the result
+    ``(S, Hkv, pages_per_slot * page_tokens, D)`` is positionally identical
+    to a dense per-lane cache row and the dense causal mask applies as-is.
+    A lane only ever gathers its OWN pages plus the shared trash page, so
+    no cross-lane bytes are touched even before masking."""
+    s_lanes, pps = tables.shape
+    _, hkv, pt, d = pages.shape
+    gathered = pages[tables]                       # (S, PPS, Hkv, pt, D)
+    return gathered.transpose(0, 2, 1, 3, 4).reshape(
+        s_lanes, hkv, pps * pt, d
+    )
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    page_tokens: int,
+) -> jax.Array:
+    """Single-position attention over a paged KV arena — the decode-step
+    counterpart of the dense slot read in ``_forward_cached_dyn``.
+
+    Shapes: q ``(S, Hq, 1, D)`` (one query per lane, post-RoPE),
+    k_pages/v_pages ``(n_pages, Hkv, page_tokens, D)``, tables
+    ``(S, pages_per_slot)`` int32, pos ``(S,)`` int32 query positions.
+    Returns f32 ``(S, Hq, 1, D)``.
+
+    The math mirrors the dense path operation-for-operation (GQA grouped
+    K/V, dots in the stored dtype with f32 accumulation via
+    ``preferred_element_type``, mask ``k_pos <= pos`` at NEG_INF, probs
+    cast to the cache dtype for the value dot) so that with
+    ``page_tokens`` dividing ``max_seq`` the reductions run over the same
+    length in the same order and greedy decode is token-for-token
+    identical to the dense engine. Junk rows — trash-page bytes behind
+    unreserved table entries and a lane's own not-yet-written positions —
+    sit strictly above ``pos`` and are masked before the softmax."""
+    s_lanes, hq, _, d = q.shape
+    hkv = k_pages.shape[1]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    kc = paged_gather_kv(k_pages, tables, page_tokens)   # (S, Hkv, L, D)
+    vc = paged_gather_kv(v_pages, tables, page_tokens)
+    qg = q.reshape(s_lanes, hkv, g, 1, d)
+    s = jnp.einsum(
+        "bkgqd,bkld->bkgql", qg, kc, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    k_pos = jnp.arange(kc.shape[2])
+    mask = k_pos[None, None, :] <= pos[:, None, None]    # (S, 1, L)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bkgql,bkld->bkgqd", p.astype(vc.dtype), vc,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(s_lanes, hq, 1, d)
+
+
 TPU_BACKENDS = ("tpu", "axon")  # axon = tunneled TPU plugin in this image
 
 

@@ -71,6 +71,8 @@ class LocalServingBackend(ServingBackend):
         generate_engine: str = "coalesce",
         generate_slots: int = 8,
         generate_chunk_tokens: int = 8,
+        kv_page_tokens: int = 0,
+        kv_arena_pages: int = 0,
     ) -> None:
         self.manager = manager
         # JAX dispatch is effectively serialized per device; a few workers
@@ -115,6 +117,8 @@ class LocalServingBackend(ServingBackend):
                 slots=generate_slots,
                 chunk_tokens=generate_chunk_tokens,
                 metrics=manager.metrics,
+                page_tokens=kv_page_tokens,
+                arena_pages=kv_arena_pages,
             )
 
     async def _run(self, fn, *args):

@@ -671,8 +671,10 @@ class _ContinuousScheduler:
                     pass
                 state = None
                 self.engine._set_active(self.model_id, 0)
+                self.engine._set_pages(self.model_id, 0, 0)
         self._fail(doomed, RuntimeError_("continuous generate engine closed"))
         self.engine._set_active(self.model_id, 0)
+        self.engine._set_pages(self.model_id, 0, 0)
 
     def _step(self, rt, state, lanes):
         """One chunk boundary: admit into free lanes, then advance all
@@ -688,9 +690,19 @@ class _ContinuousScheduler:
                 req = self.pending.popleft()
                 if eng.metrics is not None:
                     eng.metrics.batcher_queue_depth.labels("generate").dec()
+            reserved_idx = None
             try:
                 if state is None:
-                    state = rt.slot_decode_state(self.model_id, eng.slots)
+                    if eng.page_tokens is None:
+                        # no engine-level override: the runtime's ServingConfig
+                        # decides (and stub runtimes keep their 2-arg surface)
+                        state = rt.slot_decode_state(self.model_id, eng.slots)
+                    else:
+                        state = rt.slot_decode_state(
+                            self.model_id, eng.slots,
+                            page_tokens=eng.page_tokens,
+                            arena_pages=eng.arena_pages,
+                        )
                 p = req.prompt.shape[0]
                 if p + req.max_new > state.max_seq:
                     req.error = RuntimeError_(
@@ -699,6 +711,36 @@ class _ContinuousScheduler:
                     )
                     req.done.set()
                     continue
+                if getattr(state, "paged", False):
+                    # admission is gated on free PAGES, not just free lanes:
+                    # the row's whole prompt + max_new budget is reserved up
+                    # front so a mid-decode row can never starve for a page
+                    budget = p + req.max_new
+                    need = state.pages_needed(budget)
+                    if need > state.arena_pages:
+                        req.error = RuntimeError_(
+                            f"request needs {need} KV pages "
+                            f"({budget} tokens) but the arena has only "
+                            f"{state.arena_pages}"
+                        )
+                        req.done.set()
+                        continue
+                    idx = free[-1]  # the lane free.pop() will hand out below
+                    if not state.reserve_pages(idx, budget):
+                        # arena exhausted: the queue BLOCKS, never fails —
+                        # the row goes back to the FRONT (FIFO preserved)
+                        # and retirements below recycle pages for the next
+                        # chunk boundary's retry. Can't deadlock: with no
+                        # active lanes every page is free and need <=
+                        # arena_pages was checked above.
+                        with self.cv:
+                            self.pending.appendleft(req)
+                            if eng.metrics is not None:
+                                eng.metrics.batcher_queue_depth.labels(
+                                    "generate"
+                                ).inc()
+                        break
+                    reserved_idx = idx
                 tok, pk, pv, hit = rt.slot_prefill(
                     self.model_id, req.prompt, req.temperature, req.top_k,
                     seed=secrets.randbits(31),
@@ -707,6 +749,8 @@ class _ContinuousScheduler:
                 # the req is already out of `pending` and not yet in `lanes`
                 # — without this the _loop doom sweep would miss it and its
                 # waiter would block until timeout
+                if reserved_idx is not None:
+                    state.release_pages(reserved_idx)
                 self._fail([req], e)
                 raise
             now = time.monotonic()
@@ -721,6 +765,8 @@ class _ContinuousScheduler:
                 )
             if (eos is not None and int(tok) == eos) or req.max_new <= 1:
                 # done at prefill: the lane was never consumed
+                if reserved_idx is not None:
+                    self._retire_pages(state, reserved_idx, req)
                 req.finish_t = now
                 req.done.set()
                 continue
@@ -736,6 +782,7 @@ class _ContinuousScheduler:
             eng._set_active(
                 self.model_id, sum(l is not None for l in lanes)
             )
+        self._update_page_gauge(state)
         if not any(l is not None for l in lanes):
             return state
         # chunk clamped to the pow2 cover of the largest remaining budget:
@@ -763,13 +810,36 @@ class _ContinuousScheduler:
                     wasted += chunk - (j + 1)
                     state.active[idx] = False
                     lanes[idx] = None
+                    if getattr(state, "paged", False):
+                        self._retire_pages(state, idx, req)
                     req.finish_t = now
                     req.done.set()
                     break
         if wasted and eng.metrics is not None:
             eng.metrics.gen_wasted_steps.labels("continuous").inc(wasted)
         eng._set_active(self.model_id, sum(l is not None for l in lanes))
+        self._update_page_gauge(state)
         return state
+
+    def _retire_pages(self, state, idx: int, req: _ContinuousReq) -> None:
+        """Recycle a finishing row's pages and record its page-granularity
+        waste: reserved capacity minus the tokens that actually occupied it
+        (prompt + emitted; the internal-fragmentation cost of fixed pages
+        plus the unconsumed max_new headroom)."""
+        eng = self.engine
+        if eng.metrics is not None:
+            cap = state.lane_capacity(idx)
+            used = req.prompt.shape[0] + len(req.tokens)
+            eng.metrics.gen_kv_page_waste.observe(max(0, cap - min(used, cap)))
+        state.release_pages(idx)
+
+    def _update_page_gauge(self, state) -> None:
+        if state is not None and getattr(state, "paged", False):
+            self.engine._set_pages(
+                self.model_id,
+                state.arena_pages - len(state.free_pages),
+                state.arena_pages,
+            )
 
 
 class ContinuousGenerateEngine:
@@ -800,19 +870,28 @@ class ContinuousGenerateEngine:
         chunk_tokens: int = 8,
         wait_timeout_s: float = 600.0,
         metrics=None,
+        page_tokens: int | None = None,
+        arena_pages: int | None = None,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
         self.chunk_tokens = max(1, int(chunk_tokens))
         self.wait_timeout_s = wait_timeout_s
         self.metrics = metrics
+        # paged-KV knobs forwarded to slot_decode_state: None = defer to the
+        # runtime's ServingConfig (kv_page_tokens / kv_arena_pages), 0 =
+        # explicit dense, > 0 = paged with this page size / arena size
+        self.page_tokens = None if page_tokens is None else int(page_tokens)
+        self.arena_pages = None if arena_pages is None else int(arena_pages)
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}
         self._active: dict[ModelId, int] = {}
+        self._pages: dict[ModelId, tuple[int, int]] = {}  # mid -> (used, total)
         self._closed = False
         # observability (tests + bench)
         self.admitted = 0
         self.chunks = 0
+        self.peak_active = 0  # high-water concurrent lanes (bench headline)
 
     def _set_active(self, model_id: ModelId, n: int) -> None:
         with self._lock:
@@ -821,8 +900,22 @@ class ContinuousGenerateEngine:
             else:
                 self._active.pop(model_id, None)
             total = sum(self._active.values())
+            if total > self.peak_active:
+                self.peak_active = total
         if self.metrics is not None:
             self.metrics.gen_slots_active.set(total)
+
+    def _set_pages(self, model_id: ModelId, used: int, total: int) -> None:
+        with self._lock:
+            if total:
+                self._pages[model_id] = (used, total)
+            else:
+                self._pages.pop(model_id, None)
+            used_sum = sum(u for u, _ in self._pages.values())
+            total_sum = sum(t for _, t in self._pages.values())
+        if self.metrics is not None:
+            self.metrics.gen_kv_pages_used.set(used_sum)
+            self.metrics.gen_kv_pages_total.set(total_sum)
 
     def _sched(self, model_id: ModelId) -> _ContinuousScheduler:
         with self._lock:

@@ -455,18 +455,23 @@ class LoadedModel:
 @dataclass
 class SlotDecodeState:
     """Device + host state of one model's continuous-decode slot array
-    (runtime/batcher.py ContinuousGenerateEngine). The K/V arrays are
-    (layers, S, n_kv, max_seq, head_dim) — one lane per slot, advanced by
-    ``_decode_chunk_jit`` and surgically written by admission inserts. The
-    host mirrors (tok/pos/active/temps/topks) are owned by the engine's
-    scheduler thread; the runtime only reads them to build chunk inputs."""
+    (runtime/batcher.py ContinuousGenerateEngine). Dense mode
+    (``page_tokens == 0``): the K/V arrays are (layers, S, n_kv, max_seq,
+    head_dim) — one lane per slot, advanced by ``_decode_chunk_jit`` and
+    surgically written by admission inserts. Paged mode: ``k``/``v`` hold
+    the shared page arena (layers, arena_pages + 1, n_kv, page_tokens, hd)
+    — page 0 is the trash page — and each lane reads/writes through its
+    ``block_tables`` row; the free-list hands pages out at admission and
+    recycles them at retirement. The host mirrors (tok/pos/active/temps/
+    topks, block tables, free-list) are owned by the engine's scheduler
+    thread; the runtime only reads them to build chunk inputs."""
 
     model_id: ModelId
     cfg_key: tuple
     family: str
     slots: int
     max_seq: int
-    k: Any                           # device (layers, S, n_kv, max_seq, hd)
+    k: Any                           # device slot array OR paged arena
     v: Any
     tok: np.ndarray                  # (S,) i32 — last sampled token per lane
     pos: np.ndarray                  # (S,) i32 — next write position
@@ -474,6 +479,49 @@ class SlotDecodeState:
     temps: np.ndarray                # (S,) f32 per-lane temperature
     topks: np.ndarray                # (S,) i32 per-lane top_k
     chunk_counter: int = 0           # host-side PRNG stream for chunk keys
+    # -- paged-arena bookkeeping (scheduler-thread-owned; page_tokens == 0
+    # means dense mode and none of these are consulted) --
+    page_tokens: int = 0
+    arena_pages: int = 0             # usable pages (excludes trash page 0)
+    pages_per_slot: int = 0          # ceil(max_seq / page_tokens)
+    block_tables: np.ndarray | None = None   # (S, pages_per_slot) i32
+    free_pages: list = field(default_factory=list)
+    lane_pages: dict = field(default_factory=dict)  # lane -> [page ids]
+
+    @property
+    def paged(self) -> bool:
+        return self.page_tokens > 0
+
+    def pages_needed(self, tokens: int) -> int:
+        return -(-int(tokens) // self.page_tokens)
+
+    def lane_capacity(self, lane: int) -> int:
+        """Token capacity currently reserved for ``lane`` (page-granular)."""
+        return len(self.lane_pages.get(lane, ())) * self.page_tokens
+
+    def reserve_pages(self, lane: int, tokens: int) -> bool:
+        """Reserve enough pages for ``tokens`` (the row's full prompt +
+        max_new budget, so a mid-decode row can never starve) and point the
+        lane's block table at them. False when the free-list can't cover
+        it — the caller blocks admission and retries after retirements."""
+        need = self.pages_needed(tokens)
+        if need > len(self.free_pages):
+            return False
+        pages = [self.free_pages.pop() for _ in range(need)]
+        self.lane_pages[lane] = pages
+        self.block_tables[lane, :] = 0
+        self.block_tables[lane, :need] = pages
+        return True
+
+    def release_pages(self, lane: int) -> None:
+        """Recycle a retired/failed lane's pages and park the lane on the
+        trash page (zeroed table row) so its frozen in-chunk rewrites can
+        never touch a recycled page's next occupant."""
+        pages = self.lane_pages.pop(lane, None)
+        if pages:
+            self.free_pages.extend(pages)
+        if self.block_tables is not None:
+            self.block_tables[lane, :] = 0
 
 
 class TPUModelRuntime(BaseRuntime):
@@ -579,6 +627,9 @@ class TPUModelRuntime(BaseRuntime):
         # _on_evict / reset_group_state / close all drop it.
         self._slot_states: dict[ModelId, SlotDecodeState] = {}
         self._slot_lock = threading.Lock()
+        # per-model once-guards for slot-state allocation (the array is big;
+        # see slot_decode_state) — entries are popped once the state lands
+        self._slot_init_guards: dict[ModelId, threading.Lock] = {}
 
     # -- load ---------------------------------------------------------------
     def ensure_loaded(self, model: Model) -> str:
@@ -1398,12 +1449,28 @@ class TPUModelRuntime(BaseRuntime):
         eos = loaded.model_def.config.get("eos_id")
         return None if eos is None else int(eos)
 
-    def slot_decode_state(self, model_id: ModelId, slots: int) -> SlotDecodeState:
-        """Create-or-get the model's slot array. One compiled decode-chunk
-        program serves all ``slots`` lanes; the array is allocated once at
-        (layers, slots, n_kv, max_seq, head_dim) and reused across requests
-        (admission overwrites a freed lane's rows before any query can read
-        them — see _slot_insert_jit)."""
+    def slot_decode_state(
+        self,
+        model_id: ModelId,
+        slots: int,
+        page_tokens: int | None = None,
+        arena_pages: int | None = None,
+    ) -> SlotDecodeState:
+        """Create-or-get the model's slot state. One compiled decode-chunk
+        program serves all ``slots`` lanes. ``page_tokens`` / ``arena_pages``
+        default to the runtime's ServingConfig knobs; ``page_tokens == 0``
+        keeps the dense (layers, slots, n_kv, max_seq, head_dim) slot array,
+        ``> 0`` allocates the paged arena instead (``arena_pages == 0`` auto-
+        sizes to slots x ceil(max_seq/page_tokens) — the dense-equivalent
+        byte budget). An existing state always wins; later callers' knobs
+        are ignored, same as ``slots``.
+
+        Allocation runs under a per-model once-guard, NOT under
+        ``_slot_lock``: the array can be hundreds of MB (seconds of HBM
+        traffic) and the map lock is taken by eviction/reset paths. The
+        guard closes the first-admission race where two concurrent first
+        requests each allocated a full slot array and one was thrown away.
+        """
         loaded = self._resident.get(model_id)
         if loaded is None:
             raise ModelNotLoadedError(f"model {model_id} is not loaded")
@@ -1416,26 +1483,71 @@ class TPUModelRuntime(BaseRuntime):
             st = self._slot_states.get(model_id)
             if st is not None:
                 return st
-        from tfservingcache_tpu.models.generation import init_cache
+            guard = self._slot_init_guards.setdefault(
+                model_id, threading.Lock()
+            )
+        with guard:
+            with self._slot_lock:
+                st = self._slot_states.get(model_id)
+            if st is not None:
+                return st  # the racer that held the guard built it
+            st = self._build_slot_state(
+                loaded, model_id, slots, page_tokens, arena_pages
+            )
+            with self._slot_lock:
+                st = self._slot_states.setdefault(model_id, st)
+                self._slot_init_guards.pop(model_id, None)
+            return st
 
+    def _build_slot_state(
+        self,
+        loaded: LoadedModel,
+        model_id: ModelId,
+        slots: int,
+        page_tokens: int | None,
+        arena_pages: int | None,
+    ) -> SlotDecodeState:
+        from tfservingcache_tpu.models.generation import (
+            init_cache,
+            init_paged_cache,
+        )
+
+        if page_tokens is None:
+            page_tokens = int(getattr(self.cfg, "kv_page_tokens", 0))
+        if arena_pages is None:
+            arena_pages = int(getattr(self.cfg, "kv_arena_pages", 0))
         cfg = loaded.model_def.config
-        cache = init_cache(cfg, slots, cfg["max_seq"])
-        st = SlotDecodeState(
+        max_seq = int(cfg["max_seq"])
+        common = dict(
             model_id=model_id,
             cfg_key=tuple(sorted((k, v) for k, v in cfg.items())),
             family=loaded.model_def.family,
             slots=slots,
-            max_seq=int(cfg["max_seq"]),
-            k=cache["k"],
-            v=cache["v"],
+            max_seq=max_seq,
             tok=np.zeros((slots,), np.int32),
             pos=np.zeros((slots,), np.int32),
             active=np.zeros((slots,), bool),
             temps=np.zeros((slots,), np.float32),
             topks=np.zeros((slots,), np.int32),
         )
-        with self._slot_lock:
-            return self._slot_states.setdefault(model_id, st)
+        if page_tokens and page_tokens > 0:
+            page_tokens = int(page_tokens)
+            pps = -(-max_seq // page_tokens)
+            usable = int(arena_pages) if arena_pages else slots * pps
+            # +1: page 0 is the trash page, permanently reserved
+            cache = init_paged_cache(cfg, usable + 1, page_tokens)
+            return SlotDecodeState(
+                k=cache["k"],
+                v=cache["v"],
+                page_tokens=page_tokens,
+                arena_pages=usable,
+                pages_per_slot=pps,
+                block_tables=np.zeros((slots, pps), np.int32),
+                free_pages=list(range(1, usable + 1)),
+                **common,
+            )
+        cache = init_cache(cfg, slots, max_seq)
+        return SlotDecodeState(k=cache["k"], v=cache["v"], **common)
 
     def drop_slot_state(self, model_id: ModelId) -> None:
         with self._slot_lock:
@@ -1510,9 +1622,21 @@ class TPUModelRuntime(BaseRuntime):
     def slot_admit(self, state: SlotDecodeState, idx: int, pk: Any, pv: Any) -> None:
         """Copy an admitted request's prefill K/V into slot lane ``idx``
         (in-place via donation). The caller (scheduler thread) owns the host
-        mirrors and sets tok/pos/active/temps/topks itself."""
-        from tfservingcache_tpu.models.generation import _slot_insert_jit
+        mirrors and sets tok/pos/active/temps/topks itself; for a paged
+        state it must have reserved the lane's pages (reserve_pages) first —
+        the insert scatters through the lane's block-table row."""
+        from tfservingcache_tpu.models.generation import (
+            _paged_insert_jit,
+            _slot_insert_jit,
+        )
 
+        if state.paged:
+            state.k, state.v = _paged_insert_jit(
+                state.k, state.v, pk, pv,
+                np.asarray(state.block_tables[idx], np.int32),
+                page_tokens=state.page_tokens,
+            )
+            return
         state.k, state.v = _slot_insert_jit(
             state.k, state.v, pk, pv, np.int32(idx)
         )
@@ -1525,7 +1649,10 @@ class TPUModelRuntime(BaseRuntime):
         engine fails its in-flight requests and drops the state)."""
         import jax
 
-        from tfservingcache_tpu.models.generation import _decode_chunk_jit
+        from tfservingcache_tpu.models.generation import (
+            _decode_chunk_jit,
+            _paged_decode_chunk_jit,
+        )
 
         loaded = self._resident.get(state.model_id)
         if loaded is None:
@@ -1534,12 +1661,22 @@ class TPUModelRuntime(BaseRuntime):
         rngs = jax.random.split(
             jax.random.PRNGKey(state.chunk_counter), chunk
         )
-        state.k, state.v, tok, pos, toks = _decode_chunk_jit(
-            loaded.params, state.k, state.v,
-            state.tok, state.pos, state.active, rngs,
-            state.temps, state.topks,
-            cfg_key=state.cfg_key, family=state.family, chunk=chunk,
-        )
+        if state.paged:
+            state.k, state.v, tok, pos, toks = _paged_decode_chunk_jit(
+                loaded.params, state.k, state.v,
+                np.asarray(state.block_tables, np.int32),
+                state.tok, state.pos, state.active, rngs,
+                state.temps, state.topks,
+                cfg_key=state.cfg_key, family=state.family, chunk=chunk,
+                page_tokens=state.page_tokens,
+            )
+        else:
+            state.k, state.v, tok, pos, toks = _decode_chunk_jit(
+                loaded.params, state.k, state.v,
+                state.tok, state.pos, state.active, rngs,
+                state.temps, state.topks,
+                cfg_key=state.cfg_key, family=state.family, chunk=chunk,
+            )
         # np.array (not asarray): device_get hands back READ-ONLY views and
         # the scheduler writes these mirrors at the next admission
         state.tok = np.array(jax.device_get(tok), dtype=np.int32)
@@ -1998,6 +2135,7 @@ class TPUModelRuntime(BaseRuntime):
             self._prefix_cache.clear()
         with self._slot_lock:
             self._slot_states.clear()
+            self._slot_init_guards.clear()
         with self._spec_lock:
             self._spec_health.clear()
 
@@ -2014,6 +2152,7 @@ class TPUModelRuntime(BaseRuntime):
         self._resident.clear()
         with self._slot_lock:
             self._slot_states.clear()
+            self._slot_init_guards.clear()
         with self._jit_lock:
             self._jitted_by_key.clear()
         with self._aot_lock:

@@ -154,6 +154,8 @@ class CacheNode:
                 generate_engine=cfg.serving.generate_engine,
                 generate_slots=cfg.serving.generate_slots,
                 generate_chunk_tokens=cfg.serving.generate_chunk_tokens,
+                kv_page_tokens=cfg.serving.kv_page_tokens,
+                kv_arena_pages=cfg.serving.kv_arena_pages,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first

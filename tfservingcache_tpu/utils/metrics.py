@@ -170,6 +170,30 @@ class Metrics:
             buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25,
                      .5, 1, 2.5, 5, 10),
         )
+        # paged KV arena (serving.kv_page_tokens > 0): occupancy of the
+        # shared page pool and the per-retirement waste that page granularity
+        # + unconsumed max_new headroom cost — the observability the arena
+        # sizing math in PERF.md "Paged KV" reads from.
+        self.gen_kv_pages_used = Gauge(
+            "tpusc_gen_kv_pages_used",
+            "KV arena pages currently reserved by in-flight continuous "
+            "generate rows (summed across models)",
+            registry=r,
+        )
+        self.gen_kv_pages_total = Gauge(
+            "tpusc_gen_kv_pages_total",
+            "Usable KV arena pages (excluding the trash page), summed "
+            "across models with live paged slot states",
+            registry=r,
+        )
+        self.gen_kv_page_waste = Histogram(
+            "tpusc_gen_kv_page_waste_tokens",
+            "Per retired row: reserved page capacity minus tokens that "
+            "actually occupied it (prompt + emitted) — internal "
+            "fragmentation of fixed pages plus unconsumed max_new headroom",
+            registry=r,
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        )
         self.assignment_warms = Counter(
             "tpusc_assignment_warms_total",
             "Models pre-loaded by the ring-assignment warmer",
