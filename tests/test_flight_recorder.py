@@ -1,0 +1,390 @@
+"""Engine flight recorder (utils/flight_recorder.py): ring semantics, the
+< 50 us/step recording budget, the /monitoring/engine surface on a live
+two-model workload, anomaly-dump triggers (SLO breach dedup, spool
+bounding), phase-attribution reconciliation, and the engine_dump tool."""
+
+import importlib.util
+import json
+import os
+import statistics
+import time
+
+import aiohttp
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.flight_recorder import (
+    RECORDER,
+    STEP_FIELDS,
+    FlightRecorder,
+    _Ring,
+)
+from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
+
+TINY = {
+    "vocab_size": 97,
+    "d_model": 48,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 96,
+    "max_seq": 64,
+}
+
+
+def _load(tmp_path, name="lm", config=TINY, metrics=None, **serving_kw):
+    export_artifact("transformer_lm", str(tmp_path), name=name, version=1, config=config)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu", **serving_kw), metrics)
+    mid = ModelId(name, 1)
+    rt.ensure_loaded(Model(identifier=mid, path=str(tmp_path / name / "1")))
+    return rt, mid
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    """The recorder is process-global (like TRACER): every test starts from
+    empty rings and disarmed dumps, and leaves them that way."""
+    RECORDER.clear()
+    RECORDER.configure(flight_dir="")
+    yield
+    RECORDER.clear()
+    RECORDER.configure(flight_dir="")
+
+
+# -- ring semantics -----------------------------------------------------------
+
+def test_ring_wraps_and_tail_is_oldest_first():
+    r = _Ring(8)
+    for i in range(20):
+        r.append((i,))
+    assert r.written == 20
+    assert [e[0] for e in r.tail(5)] == [15, 16, 17, 18, 19]
+    # a tail larger than the ring is clamped to what survived the wrap
+    assert [e[0] for e in r.tail(100)] == list(range(12, 20))
+    # before the wrap, only what was written comes back
+    r2 = _Ring(8)
+    r2.append(("only",))
+    assert r2.tail(100) == [("only",)]
+
+
+def test_snapshot_window_goodput():
+    fr = FlightRecorder(ring_entries=64)
+    # 4 lanes x 8-step chunks, 8 wasted of 64 computed step-slots
+    fr.record("m@1", "continuous", step_ms=2.0, chunk=8, active=4,
+              admitted=1, retired=1, wasted=4, queue_depth=2,
+              oldest_wait_ms=7.5)
+    fr.record("m@1", "continuous", step_ms=2.0, chunk=8, active=4,
+              admitted=0, retired=2, wasted=4)
+    snap = fr.snapshot(tail=16)
+    win = snap["models"]["m@1"]["window"]
+    assert win["step_slots"] == 64
+    assert win["wasted_steps"] == 8
+    assert win["goodput"] == pytest.approx((64 - 8) / 64)
+    assert win["max_queue_depth"] == 2
+    assert win["max_oldest_wait_ms"] == 7.5
+    step = snap["models"]["m@1"]["steps"][0]
+    assert set(step) == set(STEP_FIELDS)
+
+
+def test_watermarks_reset_on_scrape():
+    fr = FlightRecorder()
+    assert fr.observe_watermark("hbm", 100.0) == 100.0
+    assert fr.observe_watermark("hbm", 40.0) == 100.0  # peak holds
+    assert fr.watermarks(reset=True) == {"hbm": 100.0}
+    assert fr.watermarks() == {}                        # consumed
+    assert fr.observe_watermark("hbm", 40.0) == 40.0    # re-arms
+
+
+# -- overhead budget ----------------------------------------------------------
+
+def test_record_overhead_under_50us():
+    """The ring is always on: one record per dispatched chunk must stay
+    invisible next to even a stub decode step (< 50 us median, batch-of-1000
+    medians to ride out CI scheduler noise — the tracer guard's shape)."""
+    fr = FlightRecorder()
+    for _ in range(1000):  # warm allocator and code paths
+        fr.record("warm@1", "continuous", 1.0, 8, 4, 0, 0)
+    per_rec = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            fr.record("m@1", "continuous", step_ms=1.0, chunk=8, active=4,
+                      admitted=1, retired=1, pages_used=3, pages_free=5,
+                      wasted=2, queue_depth=1, oldest_wait_ms=2.0)
+        per_rec.append((time.perf_counter() - t0) / 1000)
+    assert statistics.median(per_rec) < 50e-6, per_rec
+
+
+class _StubState:
+    def __init__(self, slots, max_seq=4096):
+        self.max_seq = max_seq
+        self.tok = np.zeros(slots, np.int32)
+        self.pos = np.zeros(slots, np.int32)
+        self.active = np.zeros(slots, bool)
+        self.temps = np.zeros(slots, np.float32)
+        self.topks = np.zeros(slots, np.int32)
+
+
+class _StubRuntime:
+    """Zero-cost model surface (test_continuous_batching.py): engine time
+    IS host scheduling + recording overhead."""
+
+    mesh = None
+
+    def __init__(self, slots):
+        self._state = _StubState(slots)
+
+    def family_of(self, _m):
+        return "transformer_lm"
+
+    def eos_id_of(self, _m):
+        return None
+
+    def slot_decode_state(self, _m, _slots):
+        return self._state
+
+    def drop_slot_state(self, _m):
+        pass
+
+    def slot_prefill(self, _m, prompt, temperature, top_k, seed):
+        return 1, None, None, False
+
+    def slot_admit(self, state, idx, pk, pv):
+        pass
+
+    def slot_decode_chunk(self, state, chunk):
+        state.pos = state.pos + state.active.astype(np.int32) * chunk
+        return np.ones((state.tok.shape[0], chunk), np.int32)
+
+
+def test_stub_engine_records_every_chunk_within_budget():
+    """With the ring enabled by default (no opt-in anywhere), the stub
+    engine must both populate the per-model ring AND hold the existing
+    < 1 ms/chunk host budget — recording rides inside it."""
+    slots = 8
+    eng = ContinuousGenerateEngine(_StubRuntime(slots), slots=slots, chunk_tokens=8)
+    try:
+        mid = ModelId("stub", 1)
+        ids = np.ones((64, 4), np.int32)
+        t0 = time.perf_counter()
+        out = eng.generate(mid, ids, max_new_tokens=16)
+        elapsed = time.perf_counter() - t0
+        assert out.shape == (64, 16)
+        assert eng.chunks > 0
+        assert elapsed / eng.chunks < 1e-3
+    finally:
+        eng.close()
+    snap = RECORDER.snapshot(tail=RECORDER.ring_entries)
+    data = snap["models"]["stub@1"]
+    # every dispatched chunk left a ring entry (prefill-only boundaries may
+    # add more, never fewer)
+    dispatched = [s for s in data["steps"] if s["chunk"] > 0]
+    assert len(dispatched) == eng.chunks
+    assert data["window"]["goodput"] <= 1.0
+    # phase clocks observed for the request rows
+    assert snap["phases"]["stub@1"]
+
+
+# -- /monitoring/engine on a live two-model workload --------------------------
+
+async def test_monitoring_engine_two_model_workload(tmp_path):
+    store = tmp_path / "store"
+    for name in ("alpha", "beta"):
+        export_artifact("transformer_lm", str(store), name=name, version=1, config=TINY)
+    metrics = Metrics()
+    runtime = TPUModelRuntime(ServingConfig(platform="cpu"), metrics)
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        runtime, metrics,
+    )
+    backend = LocalServingBackend(manager, generate_engine="continuous")
+    rest = RestServingServer(backend, metrics, require_version=False)
+    rport = await rest.start(0, host="127.0.0.1")
+    try:
+        async with aiohttp.ClientSession() as s:
+            for name in ("alpha", "beta"):
+                async with s.post(
+                    f"http://127.0.0.1:{rport}/v1/models/{name}:generate",
+                    json={"input_ids": [[3, 5, 7], [2, 4, 6]],
+                          "max_new_tokens": 6},
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    assert len((await r.json())["tokens"]) == 2
+            # peek (reset=0), then consume, then confirm consumed
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/engine?reset=0"
+            ) as r:
+                assert r.status == 200
+                snap = await r.json()
+            for key in ("alpha@1", "beta@1"):
+                data = snap["models"][key]
+                assert data["recorded_steps"] > 0
+                assert 0.0 < data["window"]["goodput"] <= 1.0
+                assert data["steps"], key
+                assert snap["phases"][key]
+            assert any(k.startswith("hbm_bytes") for k in snap["watermarks"])
+            assert "dumps" in snap
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/engine"
+            ) as r:
+                assert (await r.json())["watermarks"]  # consumed this scrape
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/engine?reset=0"
+            ) as r:
+                assert (await r.json())["watermarks"] == {}
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/engine?n=bogus"
+            ) as r:
+                assert r.status == 400
+        # per-request phase attribution flowed into the histogram
+        for phase in ("queue", "prefill", "decode", "respond"):
+            assert metrics.registry.get_sample_value(
+                "tpusc_request_phase_seconds_count",
+                {"phase": phase, "engine": "continuous"},
+            ) >= 4, phase
+    finally:
+        backend.close()
+        await rest.close()
+        manager.close()
+
+
+# -- anomaly dumps ------------------------------------------------------------
+
+def _phase_hist_sum(metrics, phase):
+    return metrics.registry.get_sample_value(
+        "tpusc_request_phase_seconds_sum",
+        {"phase": phase, "engine": "continuous"},
+    )
+
+
+def test_slo_breach_dumps_once_and_phases_reconcile(tmp_path):
+    """An induced SLO breach (threshold below any real request) produces
+    exactly ONE dump via the tracer's slow-retention hook, and the dump's
+    phase notes reconcile with the request's tpusc_request_phase_seconds
+    observations — same clocks, two sinks."""
+    flight = tmp_path / "flight"
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics)
+    eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=2, metrics=metrics)
+    old_threshold = TRACER.slow_threshold_s
+    old_hook = TRACER.slow_hook
+    RECORDER.configure(flight_dir=str(flight))
+    RECORDER.install_slow_hook(TRACER)
+    TRACER.configure(slow_threshold_s=1e-6)
+    try:
+        with TRACER.span("rest", path="/v1/models/lm:generate"):
+            eng.generate(mid, np.array([[3, 5, 7]], np.int32), max_new_tokens=6)
+        dumps = [f for f in os.listdir(flight) if "slo_breach" in f]
+        assert len(dumps) == 1, dumps
+        with open(flight / dumps[0]) as fh:
+            payload = json.load(fh)
+        assert payload["reason"] == "slo_breach"
+        assert payload["context"]["trace_id"]
+        notes = payload["phases"][str(mid)]
+        assert len(notes) == 1  # one row -> one phase note
+        for phase in ("queue", "prefill", "decode", "respond"):
+            got = notes[0]["phases"][phase]
+            want = _phase_hist_sum(metrics, phase)
+            assert got == pytest.approx(want, abs=1e-3), phase
+        # the ring made it into the dump too
+        assert payload["models"][str(mid)]["recorded_steps"] > 0
+    finally:
+        TRACER.slow_hook = old_hook
+        TRACER.configure(slow_threshold_s=old_threshold)
+        eng.close()
+        rt.close()
+
+
+def test_dump_dedup_cooldown_and_spool_bound(tmp_path):
+    fr = FlightRecorder(flight_dir=str(tmp_path), max_dumps=3,
+                        dump_cooldown_s=60.0)
+    fr.record("m@1", "continuous", 1.0, 8, 4, 1, 0)
+    # dedup key: one incident = one file
+    assert fr.dump("slo_breach", dedup_key=("slo", "t1")) is not None
+    assert fr.dump("slo_breach", dedup_key=("slo", "t1")) is None
+    assert fr.dump("slo_breach", dedup_key=("slo", "t2")) is not None
+    # cooldown per (reason, model)
+    assert fr.dump("page_exhaustion", model="m@1") is not None
+    assert fr.dump("page_exhaustion", model="m@1") is None
+    assert fr.dump("page_exhaustion", model="other@1") is not None
+    # spool bounded at max_dumps, oldest pruned
+    for i in range(4):
+        assert fr.dump("engine_crash", dedup_key=("c", i)) is not None
+    files = fr.list_dumps()
+    assert len(files) == 3
+    assert all("engine_crash" in f for f in files[-3:])
+    # disabled dir -> no-op, never raises
+    off = FlightRecorder()
+    assert off.dump("slo_breach") is None
+
+
+def test_engine_crash_writes_dump(tmp_path):
+    """A scheduler-thread failure (here: a runtime whose decode dies after
+    admission) fails the in-flight rows AND leaves a flight dump."""
+
+    class _CrashingRuntime(_StubRuntime):
+        def slot_decode_chunk(self, state, chunk):
+            raise RuntimeError("device fell over")
+
+    RECORDER.configure(flight_dir=str(tmp_path / "flight"))
+    eng = ContinuousGenerateEngine(_CrashingRuntime(2), slots=2, chunk_tokens=2)
+    try:
+        with pytest.raises(Exception, match="device fell over"):
+            eng.generate(ModelId("m", 1), np.ones((1, 3), np.int32),
+                         max_new_tokens=8)
+    finally:
+        eng.close()
+    dumps = [f for f in os.listdir(tmp_path / "flight") if "engine_crash" in f]
+    assert len(dumps) == 1
+    with open(tmp_path / "flight" / dumps[0]) as fh:
+        assert "device fell over" in json.load(fh)["context"]["error"]
+
+
+# -- engine_dump tool ---------------------------------------------------------
+
+def _load_engine_dump_module():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools", "engine_dump.py")
+    spec = importlib.util.spec_from_file_location("engine_dump", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_engine_dump_tool_renders_dump(tmp_path, capsys):
+    fr = FlightRecorder(flight_dir=str(tmp_path))
+    for i in range(6):
+        fr.record("m@1", "continuous", step_ms=1.5, chunk=8, active=4,
+                  admitted=1, retired=1, wasted=2,
+                  queue_depth=(2 if 1 <= i <= 3 else 0),
+                  oldest_wait_ms=(30.0 if 1 <= i <= 3 else 0.0))
+    fr.note_phases("m@1", "continuous",
+                   {"queue": 0.001, "prefill": 0.002, "decode": 0.01,
+                    "respond": 0.0005}, trace_id="abc123")
+    fr.observe_watermark("hbm_bytes:g0", 12345.0)
+    path = fr.dump("slo_breach", dedup_key=("slo", "abc123"),
+                   trace_id="abc123", duration_s=1.25)
+    assert path is not None
+    mod = _load_engine_dump_module()
+    assert mod.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "flight dump: slo_breach" in out
+    assert "goodput=" in out
+    assert "stall spans" in out          # the queued steps form one span
+    assert "steps [1..3]" in out
+    assert "decode=10.00ms" in out
+    assert "hbm_bytes:g0" in out
+    # --latest resolves the newest dump in a dir
+    assert mod.main(["--latest", str(tmp_path)]) == 0
+    assert mod.main(["--latest", str(tmp_path / "empty")]) == 1

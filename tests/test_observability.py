@@ -432,6 +432,109 @@ def test_batcher_queue_depth_gauge_balances_to_zero():
     ) == 0
 
 
+# -- profiler endpoint -------------------------------------------------------
+
+@asynccontextmanager
+async def profiler_node(tmp_path, monkeypatch):
+    """Minimal REST server for /monitoring/profiler tests: the endpoint
+    never touches the backend, so the FakeRuntime node from make_store is
+    more than enough."""
+    import os
+
+    monkeypatch.setenv("TPUSC_PROFILER_DIR", str(tmp_path / "profiles"))
+    store = tmp_path / "store"
+    make_store(store, [("m", 1)])
+    async with observed_node(tmp_path, "p", store) as (info, metrics, _backend):
+        yield info, metrics
+
+
+async def test_profiler_invalid_duration_is_400(tmp_path, monkeypatch):
+    async with profiler_node(tmp_path, monkeypatch) as (info, _):
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{info.rest_port}/monitoring/profiler"
+                "?duration_s=nope"
+            ) as resp:
+                assert resp.status == 400
+                assert "duration_s" in (await resp.json())["error"]
+
+
+async def test_profiler_rejects_concurrent_capture(tmp_path, monkeypatch):
+    """One capture at a time: the JAX profiler is a process-wide global, so
+    a second start_trace would corrupt the first. The server serializes on
+    _profile_lock — hold it and the endpoint must 409 without touching the
+    profiler at all."""
+    store = tmp_path / "store2"
+    make_store(store, [("m", 1)])
+    cache = ModelDiskCache(str(tmp_path / "cache_prof"), capacity_bytes=1 << 20)
+    backend = LocalServingBackend(
+        CacheManager(DiskModelProvider(str(store)), cache, FakeRuntime())
+    )
+    rest = RestServingServer(backend, Metrics(), require_version=False)
+    port = await rest.start(0, host="127.0.0.1")
+    try:
+        assert rest._profile_lock.acquire(blocking=False)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/monitoring/profiler?duration_s=0.01"
+                ) as resp:
+                    assert resp.status == 409
+                    assert "in progress" in (await resp.json())["error"]
+        finally:
+            rest._profile_lock.release()
+    finally:
+        await rest.close()
+
+
+async def test_profiler_creates_trace_dir_under_env_base(tmp_path, monkeypatch):
+    """A successful capture lands under $TPUSC_PROFILER_DIR/<label>/ (the
+    label is sandboxed to a simple name — the server, not the client, picks
+    the base dir)."""
+    import os
+
+    async with profiler_node(tmp_path, monkeypatch) as (info, _):
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{info.rest_port}/monitoring/profiler"
+                "?duration_s=0.05&label=smoke"
+            ) as resp:
+                body = await resp.json()
+                assert resp.status == 200, body
+                assert body["dir"] == str(tmp_path / "profiles" / "smoke")
+        assert os.path.isdir(tmp_path / "profiles" / "smoke")
+        # bad label never escapes the base dir
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://127.0.0.1:{info.rest_port}/monitoring/profiler"
+                "?duration_s=0.01&label=../escape"
+            ) as resp:
+                assert resp.status == 400
+
+
+# -- scrape_and_merge degradation --------------------------------------------
+
+async def test_scrape_and_merge_counts_dropped_targets(caplog):
+    """A down sidecar degrades the merge, not the scrape — but the drop is
+    counted (tpusc_scrape_errors_total) and logged at warning, never
+    silent."""
+    from tfservingcache_tpu.utils.metrics import scrape_and_merge
+
+    m = Metrics()
+    own = m.render()
+    with caplog.at_level(logging.WARNING, logger="tpusc.metrics"):
+        merged = await scrape_and_merge(
+            own,
+            ["http://127.0.0.1:1/metrics", "http://127.0.0.1:2/metrics"],
+            timeout_s=0.5,
+            metrics=m,
+        )
+    # both targets dropped; own exposition survives intact
+    assert merged.startswith(own.rstrip(b"\n"))
+    assert m.registry.get_sample_value("tpusc_scrape_errors_total") == 2
+    assert any("scrape" in r.message for r in caplog.records)
+
+
 # -- metric-name stability ---------------------------------------------------
 
 # The exposition surface is an API: renames break every dashboard and alert
@@ -457,19 +560,25 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_gen_kv_page_waste_tokens",
     "tpusc_gen_kv_pages_total",
     "tpusc_gen_kv_pages_used",
+    "tpusc_gen_kv_pages_used_peak",
+    "tpusc_gen_oldest_queued_age_seconds",
     "tpusc_gen_slots_active",
     "tpusc_gen_wasted_steps",
     "tpusc_group_healthy",
     "tpusc_group_reform_events",
     "tpusc_hbm_bytes_in_use",
+    "tpusc_hbm_bytes_peak",
     "tpusc_host_tier_bytes",
+    "tpusc_host_tier_bytes_peak",
     "tpusc_models_resident",
     "tpusc_reload_source",
     "tpusc_prefix_cache_bytes",
     "tpusc_prefix_cache_hits",
     "tpusc_prefix_cache_misses",
     "tpusc_request_duration_seconds",
+    "tpusc_request_phase_seconds",
     "tpusc_requests_in_flight",
+    "tpusc_scrape_errors",
     "tpusc_spec_draft_autodisabled",
     "tpusc_spec_tokens_per_round",
 }

@@ -28,6 +28,7 @@ import numpy as np
 from tfservingcache_tpu.cache.lru import LRUEntry
 from tfservingcache_tpu.native import make_lru_cache
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 
@@ -127,8 +128,12 @@ class HostRamTier:
         )
 
     def _update_gauge(self) -> None:
+        peak = RECORDER.observe_watermark(
+            "host_tier_bytes", float(self.lru.total_bytes)
+        )
         if self.metrics is not None:
             self.metrics.host_tier_bytes.set(self.lru.total_bytes)
+            self.metrics.host_tier_bytes_peak.set(peak)
 
     def clear(self) -> None:
         self.lru.clear()

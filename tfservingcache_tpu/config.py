@@ -222,6 +222,25 @@ class TracingConfig:
 
 
 @dataclass
+class ObservabilityConfig:
+    """Engine flight recorder (utils/flight_recorder.py): per-step
+    telemetry rings are always on (they're preallocated host lists — cost
+    is bytes, not time); these knobs govern the anomaly-dump spool."""
+
+    # Spool dir for anomaly dumps (SLO breach / page-exhaustion blocking /
+    # engine-thread crash). "" disables dumps; the rings keep recording.
+    flight_dir: str = "/tmp/tpusc_flight"
+    # Per-model step-ring capacity: at a 10 ms chunk cadence 4096 entries
+    # is the last ~40 s of engine history.
+    ring_entries: int = 4096
+    # Spool bound: oldest dump files beyond this count are deleted.
+    max_dumps: int = 16
+    # Rate limit for recurring triggers (page exhaustion); SLO-breach dumps
+    # dedup per trace id instead.
+    dump_cooldown_s: float = 60.0
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     fmt: str = "text"                  # text | json (reference cfg.go:28-61)
@@ -238,6 +257,7 @@ class Config:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    observability: ObservabilityConfig = field(default_factory=ObservabilityConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
     # health probe model name (reference cfg.go:64-66 default)
     health_probe_model: str = "__TPUSC_PROBE_CHECK__"

@@ -28,6 +28,7 @@ import time
 from aiohttp import web
 
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 from tfservingcache_tpu.utils.tracing import (
@@ -129,7 +130,9 @@ class RestServingServer:
             if self.metrics_scrape_targets:
                 from tfservingcache_tpu.utils.metrics import scrape_and_merge
 
-                body = await scrape_and_merge(body, self.metrics_scrape_targets)
+                body = await scrape_and_merge(
+                    body, self.metrics_scrape_targets, metrics=self.metrics
+                )
             return web.Response(body=body, content_type="text/plain")
         if path == "/healthz":
             return web.json_response({"status": "ok"})
@@ -152,6 +155,24 @@ class RestServingServer:
                 trace_id=request.query.get("trace_id"),
             ) if n > 0 else []
             return web.json_response({"traces": traces})
+        if path == "/monitoring/engine":
+            try:
+                n = int(request.query.get("n", "64"))
+                reset = request.query.get("reset", "1").lower() in (
+                    "1", "true", "yes", "on",
+                )
+            except ValueError:
+                return web.json_response(
+                    {"error": "n must be an integer"}, status=400
+                )
+            # reset-on-scrape watermarks: each GET reports the peak since the
+            # previous GET and zeroes the marks; reset=0 peeks without
+            # consuming (OBSERVABILITY.md documents the contract)
+            snap = RECORDER.snapshot(
+                tail=max(0, n), reset_watermarks=reset
+            )
+            snap["dumps"] = RECORDER.list_dumps()
+            return web.json_response(snap)
         if path == "/monitoring/profiler" and request.method == "POST":
             return await self._capture_profile(request)
 

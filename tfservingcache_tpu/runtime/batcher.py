@@ -50,8 +50,9 @@ from tfservingcache_tpu.runtime.base import (
     RuntimeError_,
 )
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
-from tfservingcache_tpu.utils.tracing import TRACER
+from tfservingcache_tpu.utils.tracing import TRACER, current_ids
 
 log = get_logger("runtime.batcher")
 
@@ -498,13 +499,18 @@ class GenerateCoalescer:
                     )
             try:
                 if len(slots) == 1:
+                    dev_t0 = time.monotonic()
                     out = self.runtime.generate(
                         model_id, slot.ids, prompt_lengths=list(slot.lengths),
                         max_new_tokens=slot.max_new, temperature=temperature,
                         top_k=top_k, seed=secrets.randbits(31),
                     )
+                    dev_t1 = time.monotonic()
                     slot.result = out
-                    self._observe_waste(model_id, [slot], slot.max_new)
+                    wasted = self._observe_waste(model_id, [slot], slot.max_new)
+                    self._finish_drain(
+                        model_id, [slot], slot.max_new, dev_t0, dev_t1, wasted
+                    )
                     return out
                 with TRACER.span(
                     "generate_coalesce", model=str(model_id),
@@ -518,12 +524,14 @@ class GenerateCoalescer:
                         ]
                     )
                     cat_len = np.concatenate([sl.lengths for sl in slots])
+                    dev_t0 = time.monotonic()
                     toks = self.runtime.generate(
                         model_id, cat, prompt_lengths=list(cat_len),
                         max_new_tokens=max(sl.max_new for sl in slots),
                         temperature=temperature, top_k=top_k,
                         seed=secrets.randbits(31),
                     )
+                    dev_t1 = time.monotonic()
                     self.batches += 1
                     self.batched_requests += len(slots)
                     if self.metrics is not None:
@@ -534,8 +542,12 @@ class GenerateCoalescer:
                         hi = lo + sl.ids.shape[0]
                         sl.result = toks[lo:hi, : sl.max_new]
                         lo = hi
-                    self._observe_waste(
+                    wasted = self._observe_waste(
                         model_id, slots, max(sl.max_new for sl in slots)
+                    )
+                    self._finish_drain(
+                        model_id, slots, max(sl.max_new for sl in slots),
+                        dev_t0, dev_t1, wasted,
                     )
                 assert slot.result is not None
                 return slot.result
@@ -552,16 +564,15 @@ class GenerateCoalescer:
 
     def _observe_waste(
         self, model_id: ModelId, slots: list[_GenSlot], batch_max_new: int
-    ) -> None:
+    ) -> int:
         """Post-hoc padded-step accounting: the batch's scan computed
         ``next_bucket(batch_max_new)`` decode steps for EVERY row, so a row
         that hit EOS (when the model declares one) or whose own max_new was
         below the batch's kept burning steps until the drain. An estimate —
         the runtime falls back to exact sizes on bucket overshoot — but the
         comparison the metric exists for (coalesce vs continuous on one
-        workload) uses models/workloads where the bucket estimate is exact."""
-        if self.metrics is None:
-            return
+        workload) uses models/workloads where the bucket estimate is exact.
+        Returns the wasted-step count (the flight ring records it too)."""
         eos = getattr(self.runtime, "eos_id_of", lambda _m: None)(model_id)
         steps = _next_bucket(batch_max_new)
         wasted = 0
@@ -575,8 +586,56 @@ class GenerateCoalescer:
                     if hits.size:
                         useful = int(hits[0]) + 1
                 wasted += steps - useful
-        if wasted > 0:
+        if wasted > 0 and self.metrics is not None:
             self.metrics.gen_wasted_steps.labels("coalesce").inc(wasted)
+        return wasted
+
+    def _finish_drain(
+        self,
+        model_id: ModelId,
+        slots: list[_GenSlot],
+        batch_max_new: int,
+        dev_t0: float,
+        dev_t1: float,
+        wasted: int,
+    ) -> None:
+        """Flight-ring entry + phase clocks for one batch drain. The
+        coalescer's analogue of the continuous engine's chunk boundary:
+        every member admits at gate acquisition and retires at the drain,
+        so admitted == retired == the batch size. Phases: queue = gate
+        stall (the same value gen_admission_wait observed), decode = the
+        batched device call (prefill is not separable from decode inside
+        the fused generate program), respond = scatter back to rows."""
+        end_t = time.monotonic()
+        rows = sum(sl.ids.shape[0] for sl in slots)
+        RECORDER.record(
+            str(model_id), "coalesce",
+            step_ms=(dev_t1 - dev_t0) * 1e3,
+            chunk=_next_bucket(batch_max_new),
+            active=rows, admitted=len(slots), retired=len(slots),
+            wasted=wasted,
+        )
+        ids_ctx = current_ids()
+        for sl in slots:
+            phases = {
+                "queue": max(0.0, dev_t0 - sl.enqueue_t),
+                "decode": dev_t1 - dev_t0,
+                "respond": max(0.0, end_t - dev_t1),
+            }
+            if self.metrics is not None:
+                for ph, v in phases.items():
+                    self.metrics.request_phase.labels(ph, "coalesce").observe(v)
+            RECORDER.note_phases(
+                str(model_id), "coalesce", phases,
+                trace_id=ids_ctx[0] if ids_ctx else None,
+            )
+        TRACER.annotate_root(
+            phase_queue_ms=round(
+                max(0.0, dev_t0 - min(sl.enqueue_t for sl in slots)) * 1e3, 3
+            ),
+            phase_decode_ms=round((dev_t1 - dev_t0) * 1e3, 3),
+            phase_respond_ms=round(max(0.0, end_t - dev_t1) * 1e3, 3),
+        )
 
 
 @dataclass
@@ -596,6 +655,7 @@ class _ContinuousReq:
     first_tok_t: float | None = None
     finish_t: float | None = None
     prefix_hit: bool = False
+    prefill_s: float = 0.0                # slot_prefill wall time (phase clock)
 
 
 class _ContinuousScheduler:
@@ -665,6 +725,10 @@ class _ContinuousScheduler:
                     self.pending.clear()
                 lanes = [None] * self.engine.slots
                 self._fail(doomed, e)
+                RECORDER.dump(
+                    "engine_crash", model=str(self.model_id),
+                    error=repr(e), failed_rows=len(doomed),
+                )
                 try:
                     rt.drop_slot_state(self.model_id)
                 except Exception:  # noqa: BLE001 - best-effort cleanup
@@ -680,9 +744,12 @@ class _ContinuousScheduler:
         """One chunk boundary: admit into free lanes, then advance all
         active lanes by one compiled chunk. Called only from self.thread."""
         eng = self.engine
+        step_t0 = time.monotonic()
         eos = getattr(rt, "eos_id_of", lambda _m: None)(self.model_id)
         free = [i for i, l in enumerate(lanes) if l is None]
         admitted_any = False
+        admitted_n = 0
+        retired_n = 0
         while free:
             with self.cv:
                 if not self.pending:
@@ -739,8 +806,14 @@ class _ContinuousScheduler:
                                 eng.metrics.batcher_queue_depth.labels(
                                     "generate"
                                 ).inc()
+                        RECORDER.dump(
+                            "page_exhaustion", model=str(self.model_id),
+                            needed_pages=need, free_pages=len(state.free_pages),
+                            arena_pages=state.arena_pages,
+                        )
                         break
                     reserved_idx = idx
+                pf0 = time.monotonic()
                 tok, pk, pv, hit = rt.slot_prefill(
                     self.model_id, req.prompt, req.temperature, req.top_k,
                     seed=secrets.randbits(31),
@@ -754,11 +827,13 @@ class _ContinuousScheduler:
                 self._fail([req], e)
                 raise
             now = time.monotonic()
+            req.prefill_s = now - pf0
             req.admitted_t = req.first_tok_t = now
             req.prefix_hit = hit
             req.tokens.append(int(tok))
             eng.admitted += 1
             admitted_any = True
+            admitted_n += 1
             if eng.metrics is not None:
                 eng.metrics.gen_admission_wait.labels("continuous").observe(
                     max(0.0, now - req.enqueue_t)
@@ -769,6 +844,7 @@ class _ContinuousScheduler:
                     self._retire_pages(state, reserved_idx, req)
                 req.finish_t = now
                 req.done.set()
+                retired_n += 1
                 continue
             idx = free.pop()
             rt.slot_admit(state, idx, pk, pv)
@@ -784,6 +860,10 @@ class _ContinuousScheduler:
             )
         self._update_page_gauge(state)
         if not any(l is not None for l in lanes):
+            if admitted_n or retired_n:
+                # prefill-only boundary (every admitted row finished at its
+                # first token): still a ring entry, with no chunk dispatched
+                self._record_step(state, 0, 0, admitted_n, retired_n, 0, step_t0)
             return state
         # chunk clamped to the pow2 cover of the largest remaining budget:
         # when every active row needs < chunk_tokens more, a smaller
@@ -792,6 +872,7 @@ class _ContinuousScheduler:
             l.max_new - len(l.tokens) for l in lanes if l is not None
         )
         chunk = max(1, min(eng.chunk_tokens, _next_bucket(max_remaining)))
+        active_rows = sum(l is not None for l in lanes)
         toks = rt.slot_decode_chunk(state, chunk)
         eng.chunks += 1
         now = time.monotonic()
@@ -814,12 +895,47 @@ class _ContinuousScheduler:
                         self._retire_pages(state, idx, req)
                     req.finish_t = now
                     req.done.set()
+                    retired_n += 1
                     break
         if wasted and eng.metrics is not None:
             eng.metrics.gen_wasted_steps.labels("continuous").inc(wasted)
         eng._set_active(self.model_id, sum(l is not None for l in lanes))
         self._update_page_gauge(state)
+        self._record_step(
+            state, chunk, active_rows, admitted_n, retired_n, wasted, step_t0
+        )
         return state
+
+    def _record_step(
+        self, state, chunk, active, admitted, retired, wasted, step_t0
+    ) -> None:
+        """One flight-recorder ring entry per chunk boundary, plus the
+        oldest-queued-age gauge (`gen_admission_wait` only observes at
+        admission — a row starved behind page exhaustion is invisible there
+        until it finally admits; this gauge shows it starving)."""
+        eng = self.engine
+        with self.cv:
+            depth = len(self.pending)
+            oldest_t = self.pending[0].enqueue_t if depth else None
+        wait_ms = (
+            0.0 if oldest_t is None
+            else max(0.0, (time.monotonic() - oldest_t) * 1e3)
+        )
+        if eng.metrics is not None:
+            eng.metrics.gen_oldest_queued_age.labels("continuous").set(
+                wait_ms / 1e3
+            )
+        paged = state is not None and getattr(state, "paged", False)
+        RECORDER.record(
+            str(self.model_id), "continuous",
+            step_ms=(time.monotonic() - step_t0) * 1e3,
+            chunk=chunk, active=active, admitted=admitted, retired=retired,
+            pages_used=(
+                state.arena_pages - len(state.free_pages) if paged else 0
+            ),
+            pages_free=len(state.free_pages) if paged else 0,
+            wasted=wasted, queue_depth=depth, oldest_wait_ms=wait_ms,
+        )
 
     def _retire_pages(self, state, idx: int, req: _ContinuousReq) -> None:
         """Recycle a finishing row's pages and record its page-granularity
@@ -903,7 +1019,11 @@ class ContinuousGenerateEngine:
             if total > self.peak_active:
                 self.peak_active = total
         if self.metrics is not None:
-            self.metrics.gen_slots_active.set(total)
+            # per-model series when model_labels is on (which model's lanes
+            # are saturated), one all_models total otherwise
+            label = self.metrics.model_label(model_id.name, model_id.version)
+            value = n if self.metrics.model_labels else total
+            self.metrics.gen_slots_active.labels(label).set(value)
 
     def _set_pages(self, model_id: ModelId, used: int, total: int) -> None:
         with self._lock:
@@ -913,9 +1033,11 @@ class ContinuousGenerateEngine:
                 self._pages.pop(model_id, None)
             used_sum = sum(u for u, _ in self._pages.values())
             total_sum = sum(t for _, t in self._pages.values())
+        peak = RECORDER.observe_watermark("gen_kv_pages_used", float(used_sum))
         if self.metrics is not None:
             self.metrics.gen_kv_pages_used.set(used_sum)
             self.metrics.gen_kv_pages_total.set(total_sum)
+            self.metrics.gen_kv_pages_used_peak.set(peak)
 
     def _sched(self, model_id: ModelId) -> _ContinuousScheduler:
         with self._lock:
@@ -1005,6 +1127,36 @@ class ContinuousGenerateEngine:
         for i, r in enumerate(reqs):
             t = np.asarray(r.tokens[:max_new_tokens], np.int32)
             out[i, : t.shape[0]] = t
+        # phase clocks (queue -> prefill -> decode -> respond), observed from
+        # the CALLER's thread once every row is done: queue ends where the
+        # scheduler starts the row's prefill, decode runs first token ->
+        # finish, respond is the wait for batch-mates plus output assembly.
+        # The worst row's attribution lands on the trace root — the request
+        # was as slow as its slowest row.
+        end_t = time.monotonic()
+        ids_ctx = current_ids()
+        worst: dict[str, float] = {}
+        for r in reqs:
+            admitted = r.admitted_t or r.enqueue_t
+            finish = r.finish_t or admitted
+            phases = {
+                "queue": max(0.0, admitted - r.enqueue_t - r.prefill_s),
+                "prefill": r.prefill_s,
+                "decode": max(0.0, finish - (r.first_tok_t or admitted)),
+                "respond": max(0.0, end_t - finish),
+            }
+            if self.metrics is not None:
+                for ph, v in phases.items():
+                    self.metrics.request_phase.labels(
+                        ph, "continuous"
+                    ).observe(v)
+            for ph, v in phases.items():
+                if v > worst.get(ph, -1.0):
+                    worst[ph] = v
+            RECORDER.note_phases(
+                str(model_id), "continuous", phases,
+                trace_id=ids_ctx[0] if ids_ctx else None,
+            )
         # span annotation from the CALLER's thread (the scheduler thread has
         # no ambient trace — a span opened there would be an orphan root)
         TRACER.annotate(
@@ -1015,6 +1167,9 @@ class ContinuousGenerateEngine:
                 ), 3,
             ),
             gen_prefix_hits=sum(1 for r in reqs if r.prefix_hit),
+        )
+        TRACER.annotate_root(
+            **{f"phase_{ph}_ms": round(v * 1e3, 3) for ph, v in worst.items()}
         )
         if return_stats:
             stats = [

@@ -35,6 +35,7 @@ from tfservingcache_tpu.config import ServingConfig
 from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, load_artifact
 from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
 from tfservingcache_tpu.types import Model, ModelId, ModelState
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 from tfservingcache_tpu.utils.tracing import TRACER
@@ -2140,9 +2141,13 @@ class TPUModelRuntime(BaseRuntime):
             self._spec_health.clear()
 
     def _update_gauges(self) -> None:
+        peak = RECORDER.observe_watermark(
+            f"hbm_bytes:g{self.group}", float(self._resident.total_bytes)
+        )
         if self.metrics is None:
             return
         self.metrics.hbm_bytes_in_use.labels(str(self.group)).set(self._resident.total_bytes)
+        self.metrics.hbm_bytes_peak.labels(str(self.group)).set(peak)
         self.metrics.models_resident.labels(str(self.group)).set(len(self._resident))
 
     def close(self) -> None:

@@ -19,6 +19,7 @@ from tfservingcache_tpu.config import Config
 from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
 from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
 from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 from tfservingcache_tpu.utils.tracing import TRACER
@@ -239,6 +240,15 @@ async def serve(cfg: Config) -> None:
         slow_threshold_s=cfg.tracing.slow_threshold_ms / 1000.0,
         slow_capacity=cfg.tracing.slow_capacity,
     )
+    # flight-recorder rings are always on; anomaly dumps arm here, and every
+    # slow-retained root (SLO breach) now also snapshots the engine
+    RECORDER.configure(
+        flight_dir=cfg.observability.flight_dir or None,
+        ring_entries=cfg.observability.ring_entries,
+        max_dumps=cfg.observability.max_dumps,
+        dump_cooldown_s=cfg.observability.dump_cooldown_s,
+    )
+    RECORDER.install_slow_hook(TRACER)
     node = CacheNode(cfg)
     rest_port, grpc_port = await node.start()
     log.info(

@@ -1,0 +1,352 @@
+"""Engine flight recorder: always-on per-step telemetry + anomaly dumps.
+
+The continuous engine (runtime/batcher.py) is a black box between
+"admitted" and "retired": the SLO histograms say a request was slow, the
+traces say which request, but neither says what the ENGINE was doing —
+queue depth, lane occupancy, page pressure, wasted steps — at the moment
+it went wrong. This module is the box's flight recorder:
+
+- **Step ring**: one fixed-size record per dispatched decode chunk (and
+  per coalescer batch drain) into a per-model ring buffer, ~4096 entries
+  by default. Writes are lock-free on the hot path: a preallocated list,
+  an ``itertools.count`` (atomic under the GIL) for slot assignment, and
+  one tuple build — tens of microseconds, guarded by
+  tests/test_flight_recorder.py (< 50 us/step).
+- **Phase notes**: the per-request phase clocks (queue -> prefill ->
+  decode -> respond) that also feed ``tpusc_request_phase_seconds`` are
+  mirrored here (bounded deque per model) so a dump carries the exact
+  per-request attribution for the window that triggered it.
+- **Watermarks**: high-water marks (HBM in use, host-tier bytes, KV arena
+  pages) observed at the existing gauge-update sites. Reset-on-scrape:
+  ``GET /monitoring/engine`` returns them and zeroes the marks, so each
+  scrape interval reports its own peak (pass ``reset=0`` to peek).
+- **Anomaly dumps**: SLO breach (hooked into the tracer's slow-trace
+  retention path), page-exhaustion blocking, and engine-thread crash each
+  write the full ring + engine state to a bounded spool dir
+  (``observability.flight_dir``). Dumps are deduplicated (per trace id)
+  and rate-limited (per reason+model cooldown) so one incident is one
+  file, not a disk-filling stream. ``tools/engine_dump.py`` pretty-prints
+  them for postmortems.
+
+Like the tracer (utils/tracing.py) the recorder is a process-wide default
+instance: diagnostics are write-mostly and bounded, so a global keeps
+every call site plumbing-free; tests construct their own instances or
+snapshot/clear the global. Rings record from construction; dumps stay OFF
+until ``configure(flight_dir=...)`` (server startup) so bare components in
+tests never touch the filesystem.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("flight_recorder")
+
+# One record per dispatched chunk / batch drain. Fixed tuple layout (not a
+# dict) keeps the hot-path write a single list-slot assignment; the names
+# are the serialization contract for snapshots, dumps, and
+# tools/engine_dump.py.
+STEP_FIELDS = (
+    "t_wall",          # epoch seconds at record time
+    "engine",          # "continuous" | "coalesce"
+    "step_ms",         # wall time of this chunk boundary / batch drain
+    "chunk",           # decode steps computed per lane this dispatch
+    "active",          # lanes (rows) the dispatch computed for
+    "admitted",        # rows admitted at this boundary
+    "retired",         # rows retired at this boundary
+    "pages_used",      # KV arena pages reserved after this step (0 = dense)
+    "pages_free",      # KV arena pages free after this step
+    "wasted",          # steps computed for already-finished rows this step
+    "queue_depth",     # rows still waiting for admission
+    "oldest_wait_ms",  # age of the oldest queued row (0 when queue empty)
+)
+
+DEFAULT_RING_ENTRIES = 4096
+_PHASE_NOTES_PER_MODEL = 64
+
+
+class _Ring:
+    """Lock-free fixed-size ring of step tuples: one writer-side atomic
+    counter hands out slots, so concurrent writers (coalescer leaders of
+    the same model) never block each other; a torn read during snapshot
+    costs at most one misordered diagnostic row, never a crash."""
+
+    def __init__(self, entries: int) -> None:
+        self.entries = entries
+        self.buf: list[tuple | None] = [None] * entries
+        self._ctr = itertools.count()
+        self.written = 0  # monotonic-ish total (racy, diagnostics only)
+
+    def append(self, rec: tuple) -> None:
+        i = next(self._ctr)
+        self.buf[i % self.entries] = rec
+        self.written = i + 1
+
+    def tail(self, n: int) -> list[tuple]:
+        """Last ``n`` records, oldest first."""
+        w = self.written
+        buf = list(self.buf)  # snapshot (GIL-atomic copy of references)
+        n = max(0, min(n, w, self.entries))
+        out = []
+        for i in range(w - n, w):
+            rec = buf[i % self.entries]
+            if rec is not None:
+                out.append(rec)
+        return out
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        ring_entries: int = DEFAULT_RING_ENTRIES,
+        flight_dir: str | None = None,
+        max_dumps: int = 16,
+        dump_cooldown_s: float = 60.0,
+    ) -> None:
+        self.ring_entries = max(16, int(ring_entries))
+        self.flight_dir = flight_dir
+        self.max_dumps = max(1, int(max_dumps))
+        self.dump_cooldown_s = float(dump_cooldown_s)
+        self._lock = threading.Lock()        # structure mutations only
+        self._rings: dict[str, _Ring] = {}
+        self._phases: dict[str, collections.deque] = {}
+        self._marks: dict[str, float] = {}
+        self._dump_seq = itertools.count()
+        self._dumped_keys: collections.deque = collections.deque(maxlen=256)
+        self._last_dump: dict[tuple, float] = {}
+
+    def configure(
+        self,
+        flight_dir: str | None = None,
+        ring_entries: int | None = None,
+        max_dumps: int | None = None,
+        dump_cooldown_s: float | None = None,
+    ) -> None:
+        """Apply config to the process-wide recorder (server startup). An
+        empty/None ``flight_dir`` keeps dumps disabled; existing rings keep
+        their size (resizing would drop the history worth keeping)."""
+        with self._lock:
+            if flight_dir is not None:
+                self.flight_dir = flight_dir or None
+            if ring_entries is not None:
+                self.ring_entries = max(16, int(ring_entries))
+            if max_dumps is not None:
+                self.max_dumps = max(1, int(max_dumps))
+            if dump_cooldown_s is not None:
+                self.dump_cooldown_s = float(dump_cooldown_s)
+
+    def install_slow_hook(self, tracer: Any) -> None:
+        """Hook the tracer's slow-trace retention path: every root span
+        that crosses ``slow_threshold_s`` (the same tail-sampling gate that
+        keeps the trace findable) also triggers one engine dump, deduped by
+        trace id so one breached request is exactly one file."""
+        tracer.slow_hook = self._on_slow_trace
+
+    def _on_slow_trace(self, span: Any) -> None:
+        self.dump(
+            "slo_breach",
+            dedup_key=("slo", span.trace_id),
+            trace_id=span.trace_id,
+            root_span=span.name,
+            duration_s=round(span.duration_s, 6),
+            attrs=dict(span.attrs),
+        )
+
+    # -- hot path ------------------------------------------------------------
+    def _ring(self, model: str) -> _Ring:
+        ring = self._rings.get(model)
+        if ring is None:
+            with self._lock:
+                ring = self._rings.setdefault(model, _Ring(self.ring_entries))
+        return ring
+
+    def record(
+        self,
+        model: str,
+        engine: str,
+        step_ms: float,
+        chunk: int,
+        active: int,
+        admitted: int,
+        retired: int,
+        pages_used: int = 0,
+        pages_free: int = 0,
+        wasted: int = 0,
+        queue_depth: int = 0,
+        oldest_wait_ms: float = 0.0,
+    ) -> None:
+        self._ring(model).append((
+            time.time(), engine, round(step_ms, 4), chunk, active, admitted,
+            retired, pages_used, pages_free, wasted, queue_depth,
+            round(oldest_wait_ms, 3),
+        ))
+
+    def note_phases(
+        self,
+        model: str,
+        engine: str,
+        phases: dict[str, float],
+        trace_id: str | None = None,
+    ) -> None:
+        """Mirror one request's phase clocks (the same values observed into
+        ``tpusc_request_phase_seconds``) so dumps carry exact per-request
+        attribution for the triggering window."""
+        dq = self._phases.get(model)
+        if dq is None:
+            with self._lock:
+                dq = self._phases.setdefault(
+                    model, collections.deque(maxlen=_PHASE_NOTES_PER_MODEL)
+                )
+        dq.append({
+            "t_wall": time.time(),
+            "engine": engine,
+            "trace_id": trace_id or "",
+            "phases": {k: round(v, 6) for k, v in phases.items()},
+        })
+
+    def observe_watermark(self, key: str, value: float) -> float:
+        """Track a high-water mark; returns the current peak so the call
+        site can mirror it into its Prometheus peak gauge."""
+        cur = self._marks.get(key, 0.0)
+        if value > cur:
+            self._marks[key] = value
+            return float(value)
+        return float(cur)
+
+    # -- read side -----------------------------------------------------------
+    def watermarks(self, reset: bool = False) -> dict[str, float]:
+        with self._lock:
+            out = dict(self._marks)
+            if reset:
+                self._marks.clear()
+        return out
+
+    @staticmethod
+    def _window(entries: list[tuple]) -> dict[str, Any]:
+        """Aggregate a step window: goodput = useful / total computed
+        step-slots (useful = active*chunk - wasted), the one-number answer
+        to "is the engine's compute going to live requests"."""
+        total = sum(e[4] * e[3] for e in entries)       # active * chunk
+        wasted = sum(e[9] for e in entries)
+        return {
+            "steps": len(entries),
+            "step_slots": total,
+            "wasted_steps": wasted,
+            "goodput": round((total - wasted) / total, 6) if total else 1.0,
+            "step_ms_sum": round(sum(e[2] for e in entries), 3),
+            "max_queue_depth": max((e[10] for e in entries), default=0),
+            "max_oldest_wait_ms": max((e[11] for e in entries), default=0.0),
+        }
+
+    def snapshot(self, tail: int = 64, reset_watermarks: bool = False) -> dict[str, Any]:
+        """JSON-ready engine state: per-model step window + aggregates,
+        phase notes, watermarks. The ``/monitoring/engine`` payload."""
+        with self._lock:
+            rings = dict(self._rings)
+            phases = {m: list(dq) for m, dq in self._phases.items()}
+        models: dict[str, Any] = {}
+        for model, ring in rings.items():
+            entries = ring.tail(tail)
+            models[model] = {
+                "recorded_steps": ring.written,
+                "window": self._window(entries),
+                "steps": [dict(zip(STEP_FIELDS, e)) for e in entries],
+            }
+        return {
+            "ring_entries": self.ring_entries,
+            "models": models,
+            "phases": phases,
+            "watermarks": self.watermarks(reset=reset_watermarks),
+        }
+
+    # -- anomaly dumps -------------------------------------------------------
+    def dump(
+        self,
+        reason: str,
+        dedup_key: tuple | None = None,
+        model: str | None = None,
+        **context: Any,
+    ) -> str | None:
+        """Write the full ring + engine state to the spool dir. Returns the
+        file path, or None when dumps are disabled / deduped / cooling
+        down. Never raises: a failing dump must not fail the request or
+        kill the scheduler thread that tripped it."""
+        if self.flight_dir is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if dedup_key is not None:
+                if dedup_key in self._dumped_keys:
+                    return None
+                self._dumped_keys.append(dedup_key)
+            else:
+                cool_key = (reason, model or "")
+                last = self._last_dump.get(cool_key)
+                if last is not None and now - last < self.dump_cooldown_s:
+                    return None
+                self._last_dump[cool_key] = now
+            seq = next(self._dump_seq)
+        try:
+            payload = self.snapshot(tail=self.ring_entries)
+            payload.update(
+                reason=reason,
+                model=model or "",
+                time_s=time.time(),
+                context=context,
+            )
+            os.makedirs(self.flight_dir, exist_ok=True)
+            fname = (
+                f"flight_{time.strftime('%Y%m%dT%H%M%S', time.gmtime())}"
+                f"_{seq:06d}_{reason}.json"
+            )
+            path = os.path.join(self.flight_dir, fname)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, separators=(",", ":"), default=str)
+            os.replace(tmp, path)
+            self._prune_dumps()
+            log.warning("flight recorder dumped %s -> %s", reason, path)
+            return path
+        except Exception as e:  # noqa: BLE001 — diagnostics must stay non-fatal
+            log.warning("flight dump for %s failed: %s", reason, e)
+            return None
+
+    def list_dumps(self) -> list[str]:
+        if self.flight_dir is None or not os.path.isdir(self.flight_dir):
+            return []
+        return sorted(
+            f for f in os.listdir(self.flight_dir)
+            if f.startswith("flight_") and f.endswith(".json")
+        )
+
+    def _prune_dumps(self) -> None:
+        """Bound the spool dir: names embed (utc timestamp, global seq) so
+        lexical order IS write order — delete oldest beyond max_dumps."""
+        files = self.list_dumps()
+        for f in files[: max(0, len(files) - self.max_dumps)]:
+            try:
+                os.remove(os.path.join(self.flight_dir, f))
+            except OSError:
+                pass
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self._phases.clear()
+            self._marks.clear()
+            self._dumped_keys.clear()
+            self._last_dump.clear()
+
+
+# Process-wide default (same rationale as utils/tracing.TRACER): recording
+# is always on and bounded; dumps arm only when server startup configures a
+# flight_dir. Tests snapshot/clear or construct their own instances.
+RECORDER = FlightRecorder()

@@ -106,6 +106,17 @@ class Metrics:
             "tpusc_hbm_bytes_in_use", "Bytes of HBM pinned by resident models",
             ["group"], registry=r,
         )
+        # High-water twin of the gauge above: a scrape-interval peak instead
+        # of an instant sample, so a between-scrapes residency spike is
+        # visible. Backed by the flight recorder's watermarks — reading
+        # GET /monitoring/engine resets the marks (reset-on-scrape; the
+        # gauge then re-arms at the next update). See OBSERVABILITY.md.
+        self.hbm_bytes_peak = Gauge(
+            "tpusc_hbm_bytes_peak",
+            "High-water HBM bytes pinned by resident models since the last "
+            "/monitoring/engine scrape",
+            ["group"], registry=r,
+        )
         self.models_resident = Gauge(
             "tpusc_models_resident", "Models currently AVAILABLE in the runtime",
             ["group"], registry=r,
@@ -132,6 +143,12 @@ class Metrics:
             "Host DRAM held by the warm tier's packed parameter chunks",
             registry=r,
         )
+        self.host_tier_bytes_peak = Gauge(
+            "tpusc_host_tier_bytes_peak",
+            "High-water warm-tier DRAM bytes since the last "
+            "/monitoring/engine scrape (reset-on-scrape)",
+            registry=r,
+        )
         # continuous batching observability: how often requests coalesce and
         # how many ride each device call (kind = predict | generate)
         self.coalesced_batches = Counter(
@@ -147,12 +164,18 @@ class Metrics:
         # continuous comparable on the SAME metric: the coalescer records
         # its head-of-line gate stall and post-hoc padded-step waste under
         # engine="coalesce".
+        # model label gated on the metrics.model_labels flag (same
+        # cardinality rule as the cache counters): off = one "all_models"
+        # series summed across models, on = per-model lane occupancy, so a
+        # saturated model's lanes are attributable instead of hiding inside
+        # a global sum.
         self.gen_slots_active = Gauge(
             "tpusc_gen_slots_active",
             "Decode slots currently occupied by in-flight generate requests "
-            "(summed across models; capacity is serving.generate_slots per "
+            "(per model when model_labels is on, else one all_models series "
+            "summed across models; capacity is serving.generate_slots per "
             "model)",
-            registry=r,
+            ["model"], registry=r,
         )
         self.gen_wasted_steps = Counter(
             "tpusc_gen_wasted_steps",
@@ -170,6 +193,31 @@ class Metrics:
             buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25,
                      .5, 1, 2.5, 5, 10),
         )
+        # gen_admission_wait only observes AT admission: a request stuck
+        # behind page exhaustion is invisible until it finally admits. This
+        # gauge is the live view — the age of the oldest still-queued row,
+        # updated at every chunk boundary (0 when the queue is empty).
+        self.gen_oldest_queued_age = Gauge(
+            "tpusc_gen_oldest_queued_age_seconds",
+            "Age of the oldest generate request still waiting for admission "
+            "(slot or KV-page starvation shows here BEFORE the request "
+            "admits; 0 = queue empty)",
+            ["engine"], registry=r,
+        )
+        # Per-request phase attribution (runtime/batcher.py engines): where
+        # a generate request's wall time went — admission queue, prompt
+        # prefill, decode steps, or response assembly. The same clocks land
+        # as attrs on the request's trace root, so /monitoring/traces
+        # answers "where did the time go" without cross-referencing.
+        self.request_phase = Histogram(
+            "tpusc_request_phase_seconds",
+            "Per-request latency attribution by phase "
+            "(phase=queue|prefill|decode|respond, "
+            "engine=continuous|coalesce)",
+            ["phase", "engine"], registry=r,
+            buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25,
+                     .5, 1, 2.5, 5, 10, 30),
+        )
         # paged KV arena (serving.kv_page_tokens > 0): occupancy of the
         # shared page pool and the per-retirement waste that page granularity
         # + unconsumed max_new headroom cost — the observability the arena
@@ -186,6 +234,12 @@ class Metrics:
             "across models with live paged slot states",
             registry=r,
         )
+        self.gen_kv_pages_used_peak = Gauge(
+            "tpusc_gen_kv_pages_used_peak",
+            "High-water KV arena pages reserved since the last "
+            "/monitoring/engine scrape (reset-on-scrape)",
+            registry=r,
+        )
         self.gen_kv_page_waste = Histogram(
             "tpusc_gen_kv_page_waste_tokens",
             "Per retired row: reserved page capacity minus tokens that "
@@ -197,6 +251,17 @@ class Metrics:
         self.assignment_warms = Counter(
             "tpusc_assignment_warms_total",
             "Models pre-loaded by the ring-assignment warmer",
+            registry=r,
+        )
+        # scrape_and_merge degrades gracefully when a sidecar exporter is
+        # down — but "gracefully" must not mean "silently": this counts the
+        # targets each merge dropped (unreachable or unparseable), so an
+        # exporter that died weeks ago is an alertable signal, not a gap
+        # someone notices during an incident.
+        self.scrape_errors = Counter(
+            "tpusc_scrape_errors",
+            "Sidecar metrics targets dropped from a /metrics merge "
+            "(unreachable, non-200, or unparseable)",
             registry=r,
         )
         self.prefix_cache_hits = Counter(
@@ -301,7 +366,12 @@ def _emit_families(families, skip: set[str]) -> tuple[list[str], set[str]]:
     return out, emitted
 
 
-async def scrape_and_merge(own: bytes, targets: list[str], timeout_s: float = 2.0) -> bytes:
+async def scrape_and_merge(
+    own: bytes,
+    targets: list[str],
+    timeout_s: float = 2.0,
+    metrics: "Metrics | None" = None,
+) -> bytes:
     """Merge externally-scraped text-format metrics into one exposition.
 
     Reference equivalent: MetricsHandler's live scrape of TF Serving's
@@ -311,7 +381,9 @@ async def scrape_and_merge(own: bytes, targets: list[str], timeout_s: float = 2.
     this node's single /metrics endpoint. Targets are fetched concurrently
     (a down sidecar costs one timeout, not one per target), each body is
     parsed and re-emitted with cross-exporter duplicate families dropped
-    (own registry wins), and unreachable/corrupt targets are skipped."""
+    (own registry wins), and unreachable/corrupt targets are skipped —
+    counted in ``tpusc_scrape_errors_total`` and logged at warning, so a
+    degraded merge is visible, not silent."""
     if not targets:
         return own
     import logging
@@ -329,6 +401,8 @@ async def scrape_and_merge(own: bytes, targets: list[str], timeout_s: float = 2.
             logging.getLogger("tpusc.metrics").warning(
                 "metrics scrape of %s failed: %s", url, e
             )
+            if metrics is not None:
+                metrics.scrape_errors.inc()
             return None
 
     async with aiohttp.ClientSession(
@@ -347,6 +421,8 @@ async def scrape_and_merge(own: bytes, targets: list[str], timeout_s: float = 2.
             logging.getLogger("tpusc.metrics").warning(
                 "metrics scrape of %s unparseable: %s", url, e
             )
+            if metrics is not None:
+                metrics.scrape_errors.inc()
             continue
         seen |= emitted
         if lines:

@@ -234,6 +234,11 @@ class Tracer:
         self._lock = threading.Lock()
         self._traces: list[Span] = []
         self._slow: list[Span] = []
+        # called (outside the lock) with every root span that enters the
+        # slow-retention tier — the SLO-breach trigger for the engine
+        # flight recorder (utils/flight_recorder.py). Must never raise
+        # into the request; failures are swallowed.
+        self.slow_hook = None
 
     def configure(
         self,
@@ -282,14 +287,22 @@ class Tracer:
                 # of one request (gather'd ensures) interleave safely
                 parent.children.append(sp)
             else:
+                is_slow = False
                 with self._lock:
                     self._traces.append(sp)
                     if len(self._traces) > self.capacity:
                         del self._traces[: len(self._traces) - self.capacity]
                     if self.slow_threshold_s and sp.duration_s >= self.slow_threshold_s:
+                        is_slow = True
                         self._slow.append(sp)
                         if len(self._slow) > self.slow_capacity:
                             del self._slow[: len(self._slow) - self.slow_capacity]
+                hook = self.slow_hook
+                if is_slow and hook is not None:
+                    try:
+                        hook(sp)
+                    except Exception:  # noqa: BLE001 — diagnostics stay non-fatal
+                        pass
 
     def annotate(self, **attrs: Any) -> None:
         """Attach attributes to the innermost open span, if any."""

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Pretty-print a flight-recorder anomaly dump for postmortems.
+
+The serving engine writes one JSON dump per anomaly (SLO breach,
+page-exhaustion blocking, engine-thread crash) into
+``observability.flight_dir`` — see utils/flight_recorder.py for the
+format and OBSERVABILITY.md for the triggers. This tool renders the dump
+the way an on-call reads it:
+
+  - header: reason, model, trigger context (trace id / duration / error)
+  - per-model window summary: steps, goodput, wasted steps, peak queue
+  - stall spans: contiguous runs of steps with a non-empty admission
+    queue (where requests sat waiting — page or lane starvation)
+  - step timeline: the ring tail, one line per chunk boundary
+  - phase notes: per-request queue/prefill/decode/respond attribution
+  - watermarks captured at dump time
+
+Usage:
+    python tools/engine_dump.py <dump.json> [--steps N]
+    python tools/engine_dump.py --latest [<flight_dir>]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_FLIGHT_DIR = "/tmp/tpusc_flight"
+
+
+def _fmt_step(s: dict) -> str:
+    return (
+        f"  {s.get('engine', '?'):<10} step={s.get('step_ms', 0):>8.2f}ms "
+        f"chunk={s.get('chunk', 0):>3} active={s.get('active', 0):>3} "
+        f"+{s.get('admitted', 0)}/-{s.get('retired', 0)} "
+        f"wasted={s.get('wasted', 0):>3} "
+        f"pages={s.get('pages_used', 0)}/{s.get('pages_used', 0) + s.get('pages_free', 0)} "
+        f"queue={s.get('queue_depth', 0):>3} "
+        f"oldest={s.get('oldest_wait_ms', 0):>8.1f}ms"
+    )
+
+
+def _stall_spans(steps: list[dict]) -> list[tuple[int, int, int, float]]:
+    """Contiguous runs of steps with queued requests:
+    (start_idx, length, max_depth, max_wait_ms)."""
+    spans = []
+    start = None
+    depth = 0
+    wait = 0.0
+    for i, s in enumerate(steps):
+        if s.get("queue_depth", 0) > 0:
+            if start is None:
+                start, depth, wait = i, 0, 0.0
+            depth = max(depth, s.get("queue_depth", 0))
+            wait = max(wait, s.get("oldest_wait_ms", 0.0))
+        elif start is not None:
+            spans.append((start, i - start, depth, wait))
+            start = None
+    if start is not None:
+        spans.append((start, len(steps) - start, depth, wait))
+    return spans
+
+
+def render(dump: dict, max_steps: int = 32, out=sys.stdout) -> None:
+    w = out.write
+    reason = dump.get("reason", "snapshot")
+    w(f"=== flight dump: {reason} ===\n")
+    if dump.get("model"):
+        w(f"model:   {dump['model']}\n")
+    ctx = dump.get("context") or {}
+    for k in sorted(ctx):
+        w(f"{k + ':':<9}{ctx[k]}\n")
+    marks = dump.get("watermarks") or {}
+    if marks:
+        w("watermarks (high-water since last scrape):\n")
+        for k in sorted(marks):
+            w(f"  {k} = {marks[k]:.0f}\n")
+    for model, data in sorted((dump.get("models") or {}).items()):
+        win = data.get("window") or {}
+        steps = data.get("steps") or []
+        w(f"\n--- {model} ({data.get('recorded_steps', 0)} steps recorded) ---\n")
+        w(
+            f"window: {win.get('steps', 0)} steps, "
+            f"goodput={win.get('goodput', 1.0):.3f} "
+            f"({win.get('wasted_steps', 0)}/{win.get('step_slots', 0)} "
+            f"step-slots wasted), "
+            f"max queue={win.get('max_queue_depth', 0)}, "
+            f"max wait={win.get('max_oldest_wait_ms', 0.0):.1f}ms\n"
+        )
+        spans = _stall_spans(steps)
+        if spans:
+            w("stall spans (steps with a non-empty admission queue):\n")
+            for start, length, depth, wait in spans:
+                w(
+                    f"  steps [{start}..{start + length - 1}]: "
+                    f"{length} boundaries, depth<={depth}, "
+                    f"oldest wait<={wait:.1f}ms\n"
+                )
+        shown = steps[-max_steps:]
+        if shown:
+            if len(steps) > len(shown):
+                w(f"timeline (last {len(shown)} of {len(steps)}):\n")
+            else:
+                w("timeline:\n")
+            for s in shown:
+                w(_fmt_step(s) + "\n")
+    phases = dump.get("phases") or {}
+    for model, notes in sorted(phases.items()):
+        if not notes:
+            continue
+        w(f"\n--- {model}: request phase attribution ---\n")
+        for note in notes[-max_steps:]:
+            ph = note.get("phases") or {}
+            parts = " ".join(
+                f"{k}={ph[k] * 1e3:.2f}ms"
+                for k in ("queue", "prefill", "decode", "respond") if k in ph
+            )
+            tid = note.get("trace_id") or "-"
+            w(f"  [{note.get('engine', '?')}] trace={tid[:16]} {parts}\n")
+
+
+def _latest(flight_dir: str) -> str | None:
+    try:
+        names = sorted(
+            f for f in os.listdir(flight_dir)
+            if f.startswith("flight_") and f.endswith(".json")
+        )
+    except OSError:
+        return None
+    return os.path.join(flight_dir, names[-1]) if names else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", help="dump file (or flight dir with --latest)")
+    ap.add_argument(
+        "--latest", action="store_true",
+        help=f"render the newest dump in the flight dir (default {DEFAULT_FLIGHT_DIR})",
+    )
+    ap.add_argument(
+        "--steps", type=int, default=32,
+        help="max timeline rows per model (default 32)",
+    )
+    args = ap.parse_args(argv)
+    path = args.path
+    if args.latest:
+        path = _latest(path or DEFAULT_FLIGHT_DIR)
+        if path is None:
+            print("no flight dumps found", file=sys.stderr)
+            return 1
+    if not path:
+        ap.error("dump file required (or --latest)")
+    with open(path) as fh:
+        dump = json.load(fh)
+    render(dump, max_steps=args.steps)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
