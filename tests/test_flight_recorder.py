@@ -3,6 +3,7 @@
 two-model workload, anomaly-dump triggers (SLO breach dedup, spool
 bounding), phase-attribution reconciliation, and the engine_dump tool."""
 
+import asyncio
 import importlib.util
 import json
 import os
@@ -258,6 +259,112 @@ async def test_monitoring_engine_two_model_workload(tmp_path):
         backend.close()
         await rest.close()
         manager.close()
+
+
+def test_snapshot_model_filter_and_engine_stats():
+    """?model= backing: snapshot(model=...) restricts both the ring and the
+    phase sections to one tenant (unknown -> empty, not an error); and the
+    status plane's engine_stats() aggregate matches the rings."""
+    fr = FlightRecorder()
+    fr.record("alpha@1", "continuous", step_ms=1.0, chunk=8, active=4,
+              admitted=1, retired=0, wasted=4, queue_depth=2,
+              oldest_wait_ms=12.5)
+    fr.record("beta@1", "continuous", step_ms=1.0, chunk=8, active=2,
+              admitted=1, retired=1, wasted=0, queue_depth=1,
+              oldest_wait_ms=40.0)
+    fr.note_phases("alpha@1", "continuous", {"decode": 0.01})
+    fr.note_phases("beta@1", "continuous", {"decode": 0.02})
+    snap = fr.snapshot(model="alpha@1")
+    assert set(snap["models"]) == {"alpha@1"}
+    assert set(snap["phases"]) == {"alpha@1"}
+    assert fr.snapshot(model="nope@9")["models"] == {}
+    assert set(fr.snapshot()["models"]) == {"alpha@1", "beta@1"}
+    stats = fr.engine_stats()
+    assert stats["queue_depth"] == 3               # summed current depths
+    assert stats["oldest_wait_ms"] == 40.0         # worst current wait
+    # goodput over both rings: 48 step-slots computed, 4 wasted
+    assert stats["goodput"] == pytest.approx((48 - 4) / 48)
+    assert FlightRecorder().engine_stats() == {
+        "goodput": 1.0, "queue_depth": 0, "oldest_wait_ms": 0.0,
+    }
+
+
+async def test_monitoring_engine_model_query_filter(tmp_path):
+    """The REST surface of the filter: ?model=name@version returns only
+    that tenant's sections and peeking stays non-destructive."""
+    for name in ("alpha", "beta"):
+        RECORDER.record(f"{name}@1", "continuous", step_ms=1.0, chunk=4,
+                        active=1, admitted=1, retired=1)
+    rest = RestServingServer(None, require_version=False)
+    rport = await rest.start(0, host="127.0.0.1")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/engine"
+                "?model=alpha@1&reset=0"
+            ) as r:
+                assert r.status == 200
+                snap = await r.json()
+            assert set(snap["models"]) == {"alpha@1"}
+            async with s.get(
+                f"http://127.0.0.1:{rport}/monitoring/engine?reset=0"
+            ) as r:
+                assert set((await r.json())["models"]) == {"alpha@1", "beta@1"}
+    finally:
+        await rest.close()
+
+
+def test_oldest_queued_age_gauge_returns_to_zero_after_drain():
+    """Regression (stale-gauge lie): while rows overflow the slot count the
+    oldest-queued-age gauge must have risen, and once the queue drains it
+    must read 0 — not hold the last nonzero age through an idle period."""
+    metrics = Metrics()
+    slots = 2
+    eng = ContinuousGenerateEngine(_StubRuntime(slots), slots=slots,
+                                   chunk_tokens=4, metrics=metrics)
+    try:
+        # 16 rows through 2 slots: most of them wait in the admission queue
+        out = eng.generate(ModelId("q", 1), np.ones((16, 3), np.int32),
+                           max_new_tokens=8)
+        assert out.shape == (16, 8)
+    finally:
+        eng.close()
+    # the queue existed (some step recorded a positive oldest wait) ...
+    steps = RECORDER.snapshot(tail=RECORDER.ring_entries)["models"]["q@1"]["steps"]
+    assert max(s["queue_depth"] for s in steps) > 0
+    # ... and the live gauge drained back to exactly 0 with the queue
+    assert metrics.registry.get_sample_value(
+        "tpusc_gen_oldest_queued_age_seconds", {"engine": "continuous"}
+    ) == 0.0
+
+
+async def test_engine_dump_tool_renders_live_node(tmp_path, capsys):
+    """--url renders a LIVE node's /monitoring/engine (peeking with
+    reset=0), with --model narrowing to one tenant."""
+    for name in ("alpha", "beta"):
+        RECORDER.record(f"{name}@1", "continuous", step_ms=1.5, chunk=8,
+                        active=4, admitted=1, retired=1, wasted=2,
+                        queue_depth=1, oldest_wait_ms=30.0)
+    RECORDER.observe_watermark("hbm_bytes:g0", 777.0)
+    rest = RestServingServer(None, require_version=False)
+    rport = await rest.start(0, host="127.0.0.1")
+    mod = _load_engine_dump_module()
+    url = f"http://127.0.0.1:{rport}"
+    try:
+        assert await asyncio.to_thread(mod.main, ["--url", url]) == 0
+        out = capsys.readouterr().out
+        assert "flight dump: snapshot" in out
+        assert "alpha@1" in out and "beta@1" in out
+        assert "goodput=" in out
+        assert await asyncio.to_thread(
+            mod.main, ["--url", url, "--model", "alpha@1"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "alpha@1" in out and "beta@1" not in out
+        # peeks must not have consumed the node's watermarks
+        assert RECORDER.watermarks() == {"hbm_bytes:g0": 777.0}
+    finally:
+        await rest.close()
 
 
 # -- anomaly dumps ------------------------------------------------------------

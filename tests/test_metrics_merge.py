@@ -60,6 +60,26 @@ async def test_scrape_and_merge_skips_bad_targets():
     assert b"{{{" not in merged
 
 
+async def test_scrape_and_merge_dead_target_counted_and_survivors_render():
+    """Dropped-peer accounting: one dead sidecar increments
+    tpusc_scrape_errors_total exactly once, and the merged page still
+    carries BOTH the live target's families and our own registry."""
+    m = Metrics()
+    m.request_count.labels("rest").inc()
+    dead = "http://127.0.0.1:1/metrics"  # nothing listens there
+    runner, live_url = await serve_exporter(FAKE_TPU_METRICS)
+    try:
+        merged = await scrape_and_merge(m.render(), [dead, live_url], metrics=m)
+    finally:
+        await runner.cleanup()
+    assert m.registry.get_sample_value("tpusc_scrape_errors_total") == 1
+    # the survivor's families made it into the merge regardless
+    assert b"libtpu_hbm_used_bytes 12345" in merged
+    assert b"tfservingcache_proxy_requests_total" in merged
+    # the error counter itself is part of the rendered page (alertable)
+    assert b"tpusc_scrape_errors_total 1.0" in m.render()
+
+
 async def test_scrape_and_merge_dedups_cross_exporter_families():
     """Two exporters both shipping python_gc_*-style default families must
     not produce duplicate families (Prometheus rejects the whole scrape)."""

@@ -12,6 +12,8 @@ import asyncio
 import io
 import json
 import logging
+import os
+import re
 import statistics
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -570,7 +572,10 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_hbm_bytes_peak",
     "tpusc_host_tier_bytes",
     "tpusc_host_tier_bytes_peak",
+    "tpusc_fleet_model_replicas",
     "tpusc_models_resident",
+    "tpusc_peer_health_score",
+    "tpusc_peer_status_age_seconds",
     "tpusc_reload_source",
     "tpusc_prefix_cache_bytes",
     "tpusc_prefix_cache_hits",
@@ -586,6 +591,36 @@ EXPECTED_METRIC_FAMILIES = {
 
 def test_metric_family_names_are_stable():
     assert {f.name for f in Metrics().registry.collect()} == EXPECTED_METRIC_FAMILIES
+
+
+def test_metric_families_match_observability_doc():
+    """Docs-sync lint: every family registered in utils/metrics.py appears
+    in OBSERVABILITY.md's family table, and the table lists nothing that
+    isn't registered — the reference doc cannot silently rot. Counters are
+    documented with the ``_total`` suffix prometheus_client appends at
+    exposition, so the registry names are mapped the same way."""
+    doc = os.path.join(os.path.dirname(__file__), "..", "OBSERVABILITY.md")
+    with open(doc) as fh:
+        documented = {
+            m.group(1)
+            for m in re.finditer(
+                r"^\| `((?:tpusc|tfservingcache)_[a-z0-9_]+)` \|",
+                fh.read(), re.MULTILINE,
+            )
+        }
+    registered = {
+        f.name + "_total" if f.type == "counter" else f.name
+        for f in Metrics().registry.collect()
+    }
+    missing_from_doc = registered - documented
+    stale_in_doc = documented - registered
+    assert not missing_from_doc, (
+        f"families registered but absent from OBSERVABILITY.md: "
+        f"{sorted(missing_from_doc)}"
+    )
+    assert not stale_in_doc, (
+        f"families documented but not registered: {sorted(stale_in_doc)}"
+    )
 
 
 # -- overhead budget ---------------------------------------------------------

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 from typing import Callable, Mapping
 
 import aiohttp
@@ -30,6 +31,14 @@ from tfservingcache_tpu.cache.manager import (
 )
 from tfservingcache_tpu.cluster.cluster import ClusterConnection
 from tfservingcache_tpu.cluster.discovery import create_discovery
+from tfservingcache_tpu.cluster.status import (
+    STATUS_HEADER,
+    STATUS_TRAILER,
+    STATUS_WANT_HEADER,
+    STATUS_WANT_METADATA,
+    FleetView,
+    StatusExchange,
+)
 from tfservingcache_tpu.config import Config
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
 from tfservingcache_tpu.protocol.grpc_client import ServingStub, make_channel
@@ -107,18 +116,22 @@ class RoutingBackend(ServingBackend):
         retries: int = 2,
         version_labels: Mapping[str, Mapping[str, int]] | None = None,
         local_warmth: Mapping[str, Callable[[ModelId], int]] | None = None,
+        fleet: FleetView | None = None,
     ) -> None:
         self.cluster = cluster
         self.local_backends: dict[str, ServingBackend] = dict(local_backends or {})
         # ident -> residency-warmth probe (CacheManager.residency_warmth) for
-        # the chip groups served IN THIS PROCESS. Peers don't advertise cache
-        # state over the ring (membership-only discovery), so warmth can only
-        # break p2c ties toward a local group that still holds the model in
-        # HBM or the host tier; a future cache-state advertisement would
-        # extend this map to remote idents without touching _candidates.
+        # the chip groups served IN THIS PROCESS — authoritative and instant,
+        # so it still wins for local idents. Remote idents fall back to the
+        # FleetView's exchanged (staleness-gated) advertisements.
         self.local_warmth: dict[str, Callable[[ModelId], int]] = dict(
             local_warmth or {}
         )
+        # fleet status plane (cluster/status.py): when set, forwarded hops
+        # request status piggybacks, forwarding outcomes feed per-peer
+        # health EWMAs, and _candidates consumes cross-node warmth + the
+        # soft route-around. None = pre-exchange behavior (local-only).
+        self.fleet = fleet
         self.pool = PeerPool(max_message_bytes)
         self.retries = retries
         # the ring routes by name##version, so a version_label must resolve
@@ -190,7 +203,16 @@ class RoutingBackend(ServingBackend):
         collecting new work under pure random rotation). Equal in-flight
         counts fall back to residency warmth (HBM > host tier > disk >
         cold) so a replica that can promote from its warm tier beats one
-        that must refetch — cache state breaks the tie, load decides."""
+        that must refetch — cache state breaks the tie, load decides.
+
+        With a FleetView attached two things extend this: warmth covers
+        REMOTE replicas via their exchanged advertisements (not just the
+        local probe), and health splits the pair first — when exactly one
+        of the two sampled replicas scores below the health threshold the
+        healthy one leads regardless of load (soft route-around: the sick
+        peer is deprioritized, but stays in the rotation as failover and
+        keeps its ring membership — reconvergence is health recovering,
+        not a topology change)."""
         mid = ModelId(name, int(version or 0))
         key = mid.key
         nodes = self.cluster.find_nodes_for_key(key)
@@ -201,9 +223,17 @@ class RoutingBackend(ServingBackend):
         if len(nodes) < 2:
             return nodes
         i, j = random.sample(range(len(nodes)), 2)
+        if self.fleet is not None:
+            thr = self.fleet.health_threshold
+            h_i, h_j = self._health(nodes[i].ident), self._health(nodes[j].ident)
+            if (h_i < thr) != (h_j < thr):
+                start = i if h_i >= h_j else j
+                return nodes[start:] + nodes[:start]
+            # both healthy or both sick: fall through to load/warmth — a
+            # uniformly degraded pair still spreads by load
         load_i = self._inflight.get(nodes[i].ident, 0)
         load_j = self._inflight.get(nodes[j].ident, 0)
-        if load_i == load_j and self.local_warmth:
+        if load_i == load_j and (self.local_warmth or self.fleet is not None):
             start = i if self._warmth(nodes[i].ident, mid) >= self._warmth(
                 nodes[j].ident, mid
             ) else j
@@ -213,12 +243,23 @@ class RoutingBackend(ServingBackend):
 
     def _warmth(self, ident: str, model_id: ModelId) -> int:
         fn = self.local_warmth.get(ident)
-        if fn is None:
-            return 0  # no probe (remote peer): assume cold
-        try:
-            return int(fn(model_id))
-        except Exception:  # noqa: BLE001 - advisory, never fail routing
-            return 0
+        if fn is not None:
+            try:
+                return int(fn(model_id))
+            except Exception:  # noqa: BLE001 - advisory, never fail routing
+                return 0
+        if self.fleet is not None:
+            # remote peer: its exchanged advertisement (0 when stale/unknown)
+            return self.fleet.warmth(ident, model_id.key)
+        return 0  # no probe, no exchange: assume cold
+
+    def _health(self, ident: str) -> float:
+        """Per-peer routing health. Local chip groups are always 1.0 — the
+        in-process short-circuit can't connection-fail, and health is a
+        forwarding signal, not a serving-correctness one."""
+        if self.fleet is None or ident in self.local_backends:
+            return 1.0
+        return self.fleet.health(ident)
 
     async def _forward_grpc(self, service: str, method: str, name: str, version, request):
         last_err: Exception | None = None
@@ -249,18 +290,36 @@ class RoutingBackend(ServingBackend):
                 TRACER.annotate_root(route="forwarded")
                 call = None
                 self._inflight_inc(node.ident)
+                t0 = time.monotonic()
                 try:
                     stub = await self.pool.stub(node)
                     tp = format_traceparent(route_sp)
+                    metadata = []
+                    if tp:
+                        metadata.append(("traceparent", tp))
+                    if self.fleet is not None:
+                        metadata.append((STATUS_WANT_METADATA, "1"))
                     call = stub.method(service, method)(
-                        request, metadata=(("traceparent", tp),) if tp else None
+                        request, metadata=tuple(metadata) or None
                     )
                     resp = await call
-                    await self._stitch_grpc(call, route_sp, node)
+                    self._note_forward(node.ident, True, time.monotonic() - t0)
+                    await self._consume_trailers(call, route_sp, node)
                     return resp
                 except grpc.aio.AioRpcError as e:
-                    await self._stitch_grpc(call, route_sp, node)
-                    if e.code() in (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED):
+                    conn_failure = e.code() in (
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.DEADLINE_EXCEEDED,
+                    )
+                    # application-level errors (NOT_FOUND, FAILED_PRECONDITION
+                    # ...) reached a live peer: they prove health, not damage
+                    # it; only connection-level failures score against it
+                    self._note_forward(
+                        node.ident, not conn_failure,
+                        None if conn_failure else time.monotonic() - t0,
+                    )
+                    await self._consume_trailers(call, route_sp, node)
+                    if conn_failure:
                         # connection-level failure: try the next replica
                         last_err = e
                         log.warning(
@@ -274,10 +333,16 @@ class RoutingBackend(ServingBackend):
         assert last_err is not None
         raise last_err
 
-    @staticmethod
-    async def _stitch_grpc(call, route_sp, node: NodeInfo) -> None:
-        """Graft the peer's trace subtree (trailing metadata) under the route
-        span; best-effort — stitching must never fail the request."""
+    def _note_forward(
+        self, ident: str, ok: bool, latency_s: float | None
+    ) -> None:
+        if self.fleet is not None:
+            self.fleet.note_forward(ident, ok, latency_s)
+
+    async def _consume_trailers(self, call, route_sp, node: NodeInfo) -> None:
+        """Graft the peer's trace subtree and ingest its piggybacked status
+        (both trailing metadata); best-effort — neither may fail the
+        request."""
         if call is None:
             return
         try:
@@ -287,7 +352,8 @@ class RoutingBackend(ServingBackend):
         for key, value in trailers or ():
             if key == TRACE_SUBTREE_TRAILER:
                 TRACER.attach_remote(route_sp, value, peer=node.ident)
-                return
+            elif key == STATUS_TRAILER and self.fleet is not None:
+                self.fleet.ingest_encoded(value)
 
     # -- ServingBackend (gRPC shapes) ---------------------------------------
     async def predict(self, request: sv.PredictRequest) -> sv.PredictResponse:
@@ -374,21 +440,34 @@ class RoutingBackend(ServingBackend):
                 tp = format_traceparent(route_sp)
                 if tp:
                     headers["traceparent"] = tp
+                if self.fleet is not None:
+                    headers[STATUS_WANT_HEADER] = "1"
                 self._inflight_inc(node.ident)
+                t0 = time.monotonic()
                 try:
                     async with self._http_session().request(
                         method, url, data=body or None, headers=headers
                     ) as resp:
                         payload = await resp.read()
+                        # HTTP errors (404, 412 ...) reached a live peer, so
+                        # they count as transport success for health scoring
+                        self._note_forward(
+                            node.ident, True, time.monotonic() - t0
+                        )
                         subtree = resp.headers.get(TRACE_SUBTREE_HEADER)
                         if subtree:
                             TRACER.attach_remote(route_sp, subtree, peer=node.ident)
+                        if self.fleet is not None:
+                            blob = resp.headers.get(STATUS_HEADER)
+                            if blob:
+                                self.fleet.ingest_encoded(blob)
                         return RestResponse(
                             status=resp.status,
                             body=payload,
                             content_type=resp.content_type or "application/json",
                         )
                 except aiohttp.ClientConnectionError as e:
+                    self._note_forward(node.ident, False, None)
                     last_err = e
                     log.warning("peer %s unreachable for REST %s: %s", node.ident, url, e)
                     continue
@@ -435,17 +514,49 @@ class Router:
             ]
             local_backends = {}
             local_warmth = {}
+        metrics = node.metrics if node is not None else None
+        # fleet status plane: FleetView aggregates peer advertisements (from
+        # piggybacked hops + the poll fallback) into routing signals and
+        # /monitoring/cluster; disabled entirely by cluster.status_exchange
+        self.fleet: FleetView | None = None
+        self.status_exchange: StatusExchange | None = None
+        if cfg.cluster.status_exchange:
+            self.fleet = FleetView(
+                metrics=metrics,
+                stale_after_s=cfg.cluster.status_stale_after_s,
+                health_threshold=cfg.cluster.health_threshold,
+                error_alpha=cfg.cluster.health_error_alpha,
+                latency_ref_s=cfg.cluster.health_latency_ref_s,
+            )
+            local_collectors = {}
+            if node is not None:
+                for n, g in zip(self.self_nodes, node.groups):
+                    collector = getattr(g, "status", None)
+                    if collector is not None:
+                        # the collector was built before ports were bound;
+                        # rebind it to the ring ident peers will see
+                        collector.ident = n.ident
+                        local_collectors[n.ident] = collector
+            self.status_exchange = StatusExchange(
+                self.fleet,
+                local_collectors,
+                poll_interval_s=cfg.cluster.status_poll_interval_s,
+            )
+            self.cluster.on_update.append(self.status_exchange.on_update)
+            self.cluster.on_update.append(self.fleet.prune)
         self.backend = RoutingBackend(
             self.cluster,
             local_backends,
             cfg.proxy.grpc_max_message_bytes,
             version_labels=cfg.serving.version_labels,
             local_warmth=local_warmth,
+            fleet=self.fleet,
         )
-        metrics = node.metrics if node is not None else None
         self.rest = RestServingServer(
             self.backend, metrics, require_version=True, metrics_path=cfg.metrics.path
         )
+        # /monitoring/cluster is served from the router's REST port
+        self.rest.fleet = self.fleet
         self.grpc = GrpcServingServer(self.backend, metrics, cfg.proxy.grpc_max_message_bytes)
         self.warmer = None
         if node is not None and cfg.proxy.warm_on_assignment:
@@ -471,6 +582,8 @@ class Router:
         await self.cluster.connect(entries, lambda: True)
         rest_port = await self.rest.start(self.cfg.proxy.rest_port)
         grpc_port = await self.grpc.start(self.cfg.proxy.grpc_port)
+        if self.status_exchange is not None:
+            self.status_exchange.start()
         self._health_task = asyncio.create_task(self._health_loop())
         log.info(
             "router up: REST :%d gRPC :%d as %s (%d ring members)",
@@ -490,6 +603,8 @@ class Router:
         if self.warmer is not None:
             # blocking join: keep the event loop free for the teardown below
             await asyncio.to_thread(self.warmer.close)
+        if self.status_exchange is not None:
+            await self.status_exchange.close()
         await self.cluster.disconnect()
         await self.backend.close()
         await self.rest.close()

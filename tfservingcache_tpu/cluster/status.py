@@ -1,0 +1,586 @@
+"""Fleet status plane: cross-node residency/health exchange.
+
+PRs 2 and 6 made a single node deeply observable; this module makes the
+CLUSTER observable — and turns that observability into a routing signal.
+Three pieces:
+
+- **NodeStatus / StatusCollector** — a compact per-ring-member snapshot:
+  per-model residency tier (CacheManager.residency_warmth: 3=HBM,
+  2=host tier, 1=disk), engine goodput / queue depth / oldest wait (from
+  the flight recorder's cheap aggregate), KV pages free, host-tier
+  bytes, and in-flight counts. Collection is cached for
+  ``status_min_interval_s`` so piggybacking on every routed response
+  costs a dict lookup, not a recollection; a fresh collect stays under
+  1 ms on the stub runtime (guarded by tests/test_fleet_status.py, same
+  style as the flight recorder's <50 us/record guard).
+
+- **The wire** — statuses ride the channels that already exist, the
+  same pattern as the trace-subtree graft (utils/tracing.serialize_span):
+  a router that wants status sends ``x-tpusc-status-want`` (REST header)
+  or ``tpusc-status-want`` (gRPC metadata) on the forwarded request; the
+  serving peer attaches its zlib+base64 NodeStatus on the response
+  header ``x-tpusc-status`` / trailing-metadata key ``tpusc-status``.
+  Peers that see no routed traffic are covered by a low-rate poll of
+  ``GET /monitoring/status`` (StatusExchange). Payloads are size-bounded
+  (``status_byte_cap``): encode drops the coldest models first and
+  reports how many were dropped (``truncated``), so a thousand-tenant
+  node degrades to "my warmest N" instead of blowing up trailer limits.
+
+- **FleetView** — the aggregate: per-peer latest status + staleness
+  stamp + forward-outcome EWMAs, published three ways: (a) the
+  ``GET /monitoring/cluster`` payload (per-node table + per-model fleet
+  residency map — "where is model X warm, and how warm"), (b) metric
+  families ``tpusc_peer_health_score{peer}`` /
+  ``tpusc_peer_status_age_seconds{peer}`` /
+  ``tpusc_fleet_model_replicas{model,tier}``, and (c) the router's
+  signals: ``warmth(ident, key)`` extends the p2c equal-load tie-break
+  to REMOTE peers, and ``health(ident)`` (error EWMA x latency factor x
+  staleness decay) lets the router soft-route-around a sick peer —
+  deprioritized, never dropped, while it remains a ring member (the
+  ring owns placement; health only orders replicas).
+
+This is the substrate ROADMAP item 4 (λScale-style peer warm starts,
+load-adaptive replication) schedules on: DeepServe's housekeeper and
+λScale's gossip both reduce to exactly this exchange.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
+from tfservingcache_tpu.utils.logging import get_logger
+
+if TYPE_CHECKING:  # import only for annotations: keep this module light
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cluster.cluster import ClusterConnection
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+log = get_logger("status")
+
+# Request-direction markers ("please attach your status") and the
+# response-direction payload keys. gRPC metadata keys must be lowercase;
+# the payload is ASCII base64 so no -bin suffix is needed — the exact
+# conventions the trace-subtree graft established.
+STATUS_WANT_HEADER = "x-tpusc-status-want"   # REST request header
+STATUS_HEADER = "x-tpusc-status"             # REST response header
+STATUS_WANT_METADATA = "tpusc-status-want"   # gRPC invocation metadata
+STATUS_TRAILER = "tpusc-status"              # gRPC trailing metadata
+
+# residency_warmth tier -> wire/display name (manager.py:162)
+TIER_NAMES = {3: "hbm", 2: "host", 1: "disk"}
+
+DEFAULT_BYTE_CAP = 4096
+
+
+@dataclass
+class NodeStatus:
+    """One ring member's self-reported state at ``t_wall``.
+
+    ``models`` maps routing key (``name##version``) -> warmth tier
+    (3=HBM, 2=host, 1=disk); cold models are simply absent. ``seq``
+    increments per fresh collection so receivers can drop stale
+    reorderings without comparing clocks across hosts.
+    """
+
+    ident: str
+    seq: int = 0
+    t_wall: float = 0.0
+    models: dict[str, int] = field(default_factory=dict)
+    inflight: int = 0
+    queue_depth: int = 0
+    oldest_wait_s: float = 0.0
+    goodput: float = 1.0
+    kv_pages_free: int = 0
+    kv_pages_total: int = 0
+    host_tier_bytes: int = 0
+    models_resident: int = 0
+    truncated: int = 0  # models dropped from ``models`` to fit the byte cap
+
+    def to_dict(self) -> dict:
+        return {
+            "ident": self.ident,
+            "seq": self.seq,
+            "t_wall": round(self.t_wall, 3),
+            "models": self.models,
+            "inflight": self.inflight,
+            "queue_depth": self.queue_depth,
+            "oldest_wait_s": round(self.oldest_wait_s, 3),
+            "goodput": round(self.goodput, 4),
+            "kv_pages_free": self.kv_pages_free,
+            "kv_pages_total": self.kv_pages_total,
+            "host_tier_bytes": self.host_tier_bytes,
+            "models_resident": self.models_resident,
+            "truncated": self.truncated,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeStatus | None":
+        """Never raises: a malformed peer payload is dropped, not fatal."""
+        try:
+            if not isinstance(d, dict) or not d.get("ident"):
+                return None
+            models = {
+                str(k): int(v)
+                for k, v in (d.get("models") or {}).items()
+                if int(v) > 0
+            }
+            return cls(
+                ident=str(d["ident"]),
+                seq=int(d.get("seq", 0)),
+                t_wall=float(d.get("t_wall", 0.0)),
+                models=models,
+                inflight=int(d.get("inflight", 0)),
+                queue_depth=int(d.get("queue_depth", 0)),
+                oldest_wait_s=float(d.get("oldest_wait_s", 0.0)),
+                goodput=float(d.get("goodput", 1.0)),
+                kv_pages_free=int(d.get("kv_pages_free", 0)),
+                kv_pages_total=int(d.get("kv_pages_total", 0)),
+                host_tier_bytes=int(d.get("host_tier_bytes", 0)),
+                models_resident=int(d.get("models_resident", 0)),
+                truncated=int(d.get("truncated", 0)),
+            )
+        except (TypeError, ValueError):
+            return None
+
+    def encode(self, byte_cap: int = DEFAULT_BYTE_CAP) -> str:
+        """zlib+base64 compact JSON, bounded to ``byte_cap`` encoded bytes.
+
+        Over-cap payloads drop the COLDEST models first (halving rounds, so
+        pathological tenant counts converge in O(log n) re-encodes) and
+        stamp ``truncated`` with how many were cut — the receiver knows the
+        map is a warm subset, not the full inventory. Returns "" if even
+        the model-free status won't fit (caller omits the attachment)."""
+        d = self.to_dict()
+        blob = _pack(d)
+        while len(blob) > byte_cap and d["models"]:
+            items = sorted(d["models"].items(), key=lambda kv: (-kv[1], kv[0]))
+            keep = len(items) // 2
+            d["models"] = dict(items[:keep])
+            d["truncated"] = len(self.models) - keep
+            blob = _pack(d)
+        return blob if len(blob) <= byte_cap else ""
+
+    @staticmethod
+    def decode(blob: str | bytes | None) -> "NodeStatus | None":
+        """Inverse of encode; never raises (garbage from a peer is dropped)."""
+        if not blob:
+            return None
+        try:
+            raw = zlib.decompress(base64.b64decode(blob))
+            return NodeStatus.from_dict(json.loads(raw))
+        except Exception:  # noqa: BLE001 — wire input, any shape of garbage
+            return None
+
+
+def _pack(d: dict) -> str:
+    return base64.b64encode(
+        zlib.compress(json.dumps(d, separators=(",", ":")).encode(), 6)
+    ).decode()
+
+
+def _gauge_value(gauge, labels: tuple = ()) -> float:
+    """Read a prometheus_client gauge child without exposition round-trips.
+    Advisory only — any internals mismatch degrades to 0, never raises."""
+    try:
+        if labels:
+            return float(gauge.labels(*labels)._value.get())
+        return float(gauge._value.get())
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+def _gauge_sum(gauge) -> float:
+    """Sum across all label children of a labeled gauge."""
+    try:
+        return float(sum(c._value.get() for c in gauge._metrics.values()))
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+class StatusCollector:
+    """Collects THIS ring member's NodeStatus.
+
+    One collector per chip group (the ring member unit). ``ident`` starts
+    as a placeholder and is overwritten by the Router once the member's
+    real ``host:restPort:grpcPort`` identity is known — in cache-only mode
+    (no discovery) the placeholder stands, which is fine: nothing routes
+    on it, ``/monitoring/status`` just labels the local report.
+
+    ``collect()`` results and their encoding are cached for
+    ``min_interval_s``: the piggyback path runs per routed response, so
+    the steady-state cost must be a timestamp compare, not a cache walk.
+    """
+
+    def __init__(
+        self,
+        ident: str,
+        manager: "CacheManager",
+        metrics: "Metrics | None" = None,
+        byte_cap: int = DEFAULT_BYTE_CAP,
+        max_models: int = 64,
+        min_interval_s: float = 0.25,
+    ) -> None:
+        self.ident = ident
+        self.manager = manager
+        self.metrics = metrics
+        self.byte_cap = int(byte_cap)
+        self.max_models = max(1, int(max_models))
+        self.min_interval_s = float(min_interval_s)
+        self._seq = 0
+        self._cached: NodeStatus | None = None
+        self._cached_blob: str = ""
+        self._cached_mono = 0.0
+
+    def collect(self) -> NodeStatus:
+        """Fresh-or-cached snapshot (fresh when the cache aged out)."""
+        now = time.monotonic()
+        if (
+            self._cached is not None
+            and now - self._cached_mono < self.min_interval_s
+        ):
+            # ident can be rebound after caching (Router assigns ring ids)
+            self._cached.ident = self.ident
+            return self._cached
+        st = self._collect_fresh()
+        self._cached = st
+        self._cached_blob = st.encode(self.byte_cap)
+        self._cached_mono = now
+        return st
+
+    def encoded(self) -> str:
+        """Cached wire form for the piggyback path ("" = nothing to send)."""
+        self.collect()
+        return self._cached_blob
+
+    def _collect_fresh(self) -> NodeStatus:
+        self._seq += 1
+        models: dict[str, int] = {}
+        truncated = 0
+        try:
+            # tiers are inclusive (HBM ⊆ host ⊆ disk — eviction demotes
+            # through the disk cache), so the disk index enumerates every
+            # resident model and residency_warmth grades each one
+            for mid in self.manager.list_cached():
+                w = self.manager.residency_warmth(mid)
+                if w > 0:
+                    models[mid.key] = w
+        except Exception:  # noqa: BLE001 — status must never fail serving
+            pass
+        if len(models) > self.max_models:
+            items = sorted(models.items(), key=lambda kv: (-kv[1], kv[0]))
+            truncated = len(items) - self.max_models
+            models = dict(items[: self.max_models])
+        engine = RECORDER.engine_stats()
+        st = NodeStatus(
+            ident=self.ident,
+            seq=self._seq,
+            t_wall=time.time(),
+            models=models,
+            queue_depth=int(engine["queue_depth"]),
+            oldest_wait_s=float(engine["oldest_wait_ms"]) / 1000.0,
+            goodput=float(engine["goodput"]),
+            models_resident=sum(1 for w in models.values() if w == 3),
+            truncated=truncated,
+        )
+        m = self.metrics
+        if m is not None:
+            st.inflight = int(_gauge_sum(m.requests_in_flight))
+            used = _gauge_value(m.gen_kv_pages_used)
+            total = _gauge_value(m.gen_kv_pages_total)
+            st.kv_pages_total = int(total)
+            st.kv_pages_free = max(0, int(total - used))
+            st.host_tier_bytes = int(_gauge_value(m.host_tier_bytes))
+        return st
+
+
+@dataclass
+class _PeerState:
+    status: NodeStatus | None = None
+    received_mono: float | None = None
+    err_ewma: float = 0.0
+    latency_ewma_s: float = 0.0
+    forwards: int = 0
+    failures: int = 0
+
+
+class FleetView:
+    """Aggregated view of every peer's latest NodeStatus + this node's own
+    forwarding experience with them. Single-event-loop access (router and
+    REST handlers share the loop), so plain dicts are race-free."""
+
+    def __init__(
+        self,
+        metrics: "Metrics | None" = None,
+        stale_after_s: float = 15.0,
+        health_threshold: float = 0.5,
+        error_alpha: float = 0.3,
+        latency_ref_s: float = 1.0,
+    ) -> None:
+        self.metrics = metrics
+        self.stale_after_s = float(stale_after_s)
+        self.health_threshold = float(health_threshold)
+        self.error_alpha = float(error_alpha)
+        self.latency_ref_s = float(latency_ref_s)
+        self._peers: dict[str, _PeerState] = {}
+
+    # -- ingestion -----------------------------------------------------------
+    def ingest(self, status: NodeStatus | None) -> bool:
+        """Accept a peer's snapshot (from piggyback or poll). Out-of-order
+        deliveries (an older seq from the same peer) are dropped."""
+        if status is None or not status.ident:
+            return False
+        ps = self._peers.setdefault(status.ident, _PeerState())
+        if ps.status is not None and status.seq <= ps.status.seq:
+            # a racing older snapshot still refreshes the staleness stamp —
+            # the peer is alive and talking, just not newer
+            ps.received_mono = time.monotonic()
+            self._publish_peer(status.ident, ps)
+            return False
+        ps.status = status
+        ps.received_mono = time.monotonic()
+        self._publish_peer(status.ident, ps)
+        self._publish_replicas()
+        return True
+
+    def ingest_encoded(self, blob: str | bytes | None) -> bool:
+        return self.ingest(NodeStatus.decode(blob))
+
+    def note_forward(
+        self, ident: str, ok: bool, latency_s: float | None = None
+    ) -> None:
+        """Record one forwarding attempt's outcome. Only connection-level
+        failures should come in as ok=False — an application error (404,
+        FAILED_PRECONDITION) reached a live peer and proves health."""
+        ps = self._peers.setdefault(ident, _PeerState())
+        a = self.error_alpha
+        ps.err_ewma = a * (0.0 if ok else 1.0) + (1 - a) * ps.err_ewma
+        if ok and latency_s is not None:
+            ps.latency_ewma_s = a * latency_s + (1 - a) * ps.latency_ewma_s
+        ps.forwards += 1
+        if not ok:
+            ps.failures += 1
+        self._publish_peer(ident, ps)
+
+    # -- signals -------------------------------------------------------------
+    def health(self, ident: str) -> float:
+        """Composite health in [0, 1]: forward-error EWMA x latency factor x
+        staleness decay. Unknown peers score 1.0 — never penalize a peer we
+        have no evidence against (new members must receive traffic to ever
+        build a record)."""
+        ps = self._peers.get(ident)
+        if ps is None:
+            return 1.0
+        return self._score(ps)
+
+    def _score(self, ps: _PeerState) -> float:
+        score = 1.0 - ps.err_ewma
+        score *= self.latency_ref_s / (self.latency_ref_s + ps.latency_ewma_s)
+        age = self._age(ps)
+        if age is not None and age > self.stale_after_s > 0:
+            # gradual decay past the staleness horizon, not a cliff: a peer
+            # 2x stale scores half its fresh value
+            score *= self.stale_after_s / age
+        return score
+
+    @staticmethod
+    def _age(ps: _PeerState) -> float | None:
+        if ps.received_mono is None:
+            return None
+        return time.monotonic() - ps.received_mono
+
+    def status_age_s(self, ident: str) -> float | None:
+        """Seconds since this peer's status was last heard (None = never)."""
+        ps = self._peers.get(ident)
+        return self._age(ps) if ps is not None else None
+
+    def warmth(self, ident: str, key: str) -> int:
+        """Advertised residency tier of routing key ``key`` on ``ident``
+        (0 = cold / unknown / stale). The router's cross-node extension of
+        CacheManager.residency_warmth: stale advertisements don't count —
+        a peer that went quiet may have evicted anything since."""
+        ps = self._peers.get(ident)
+        if ps is None or ps.status is None:
+            return 0
+        age = self._age(ps)
+        if age is not None and age > self.stale_after_s > 0:
+            return 0
+        return ps.status.models.get(key, 0)
+
+    # -- publication ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``GET /monitoring/cluster`` payload: per-node table plus the
+        inverted per-model fleet residency map."""
+        nodes: dict[str, dict] = {}
+        models: dict[str, dict[str, list[str]]] = {}
+        for ident, ps in sorted(self._peers.items()):
+            age = self._age(ps)
+            st = ps.status
+            row = {
+                "health": round(self._score(ps), 4),
+                "status_age_s": round(age, 3) if age is not None else None,
+                "stale": bool(age is None or age > self.stale_after_s),
+                "err_ewma": round(ps.err_ewma, 4),
+                "latency_ewma_ms": round(ps.latency_ewma_s * 1e3, 3),
+                "forwards": ps.forwards,
+                "failures": ps.failures,
+            }
+            if st is not None:
+                row.update(
+                    seq=st.seq,
+                    inflight=st.inflight,
+                    queue_depth=st.queue_depth,
+                    oldest_wait_s=st.oldest_wait_s,
+                    goodput=st.goodput,
+                    kv_pages_free=st.kv_pages_free,
+                    kv_pages_total=st.kv_pages_total,
+                    host_tier_bytes=st.host_tier_bytes,
+                    models_resident=st.models_resident,
+                    models_truncated=st.truncated,
+                )
+                for key, tier in st.models.items():
+                    entry = models.setdefault(
+                        key, {name: [] for name in TIER_NAMES.values()}
+                    )
+                    entry[TIER_NAMES.get(tier, "disk")].append(ident)
+            nodes[ident] = row
+            self._publish_peer(ident, ps)
+        return {
+            "nodes": nodes,
+            "models": models,
+            "stale_after_s": self.stale_after_s,
+            "health_threshold": self.health_threshold,
+        }
+
+    def _publish_peer(self, ident: str, ps: _PeerState) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.peer_health_score.labels(ident).set(self._score(ps))
+        age = self._age(ps)
+        if age is not None:
+            self.metrics.peer_status_age.labels(ident).set(age)
+
+    def _publish_replicas(self) -> None:
+        """Recompute tpusc_fleet_model_replicas{model,tier} from scratch:
+        counts shrink when peers evict, so set-only updates would lie."""
+        if self.metrics is None:
+            return
+        counts: dict[tuple[str, str], int] = {}
+        for ps in self._peers.values():
+            if ps.status is None:
+                continue
+            for key, tier in ps.status.models.items():
+                label = key.replace("##", ":", 1)  # name##v -> name:v (metric style)
+                tname = TIER_NAMES.get(tier, "disk")
+                counts[(label, tname)] = counts.get((label, tname), 0) + 1
+        self.metrics.fleet_model_replicas.clear()
+        for (model, tier), n in counts.items():
+            self.metrics.fleet_model_replicas.labels(model, tier).set(n)
+
+    def prune(self, nodes: list[NodeInfo]) -> None:
+        """Membership-update callback: forget departed peers AND their metric
+        label series (a long-lived router must not accumulate one gauge
+        series per peer ever seen)."""
+        live = {n.ident for n in nodes}
+        for ident in [i for i in self._peers if i not in live]:
+            del self._peers[ident]
+            if self.metrics is not None:
+                for gauge in (
+                    self.metrics.peer_health_score,
+                    self.metrics.peer_status_age,
+                ):
+                    try:
+                        gauge.remove(ident)
+                    except KeyError:
+                        pass
+        self._publish_replicas()
+
+
+class StatusExchange:
+    """The periodic fallback path: piggybacking covers peers we route to;
+    this loop covers the rest (and folds this host's OWN groups into the
+    FleetView so /monitoring/cluster shows the whole fleet, self included).
+
+    ``poll_once()`` is the unit of work — the loop just schedules it, so
+    tests drive exchanges deterministically without timers."""
+
+    def __init__(
+        self,
+        fleet: FleetView,
+        local: dict[str, StatusCollector],
+        poll_interval_s: float = 5.0,
+        poll_timeout_s: float = 2.0,
+    ) -> None:
+        self.fleet = fleet
+        self.local = dict(local)
+        self.poll_interval_s = float(poll_interval_s)
+        self.poll_timeout_s = float(poll_timeout_s)
+        self._nodes: list[NodeInfo] = []
+        self._task: asyncio.Task | None = None
+        self._http = None  # lazy aiohttp session (poll path only)
+
+    def on_update(self, nodes: list[NodeInfo]) -> None:
+        """ClusterConnection.on_update callback: track live membership."""
+        self._nodes = list(nodes)
+
+    async def poll_once(self) -> int:
+        """One exchange round; returns how many statuses were refreshed.
+        Local groups are read directly; remote peers whose status is older
+        than the poll interval (or never heard) are fetched over REST."""
+        refreshed = 0
+        for collector in self.local.values():
+            if self.fleet.ingest(collector.collect()):
+                refreshed += 1
+        for node in list(self._nodes):
+            if node.ident in self.local:
+                continue
+            age = self.fleet.status_age_s(node.ident)
+            if age is not None and age < self.poll_interval_s:
+                continue  # piggyback traffic is keeping this peer fresh
+            st = await self._fetch(node)
+            if st is not None and self.fleet.ingest(st):
+                refreshed += 1
+        return refreshed
+
+    async def _fetch(self, node: NodeInfo) -> NodeStatus | None:
+        import aiohttp
+
+        if self._http is None or self._http.closed:
+            self._http = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.poll_timeout_s)
+            )
+        url = f"http://{node.host}:{node.rest_port}/monitoring/status"
+        try:
+            async with self._http.get(url) as resp:
+                if resp.status != 200:
+                    return None
+                return NodeStatus.from_dict(await resp.json())
+        except Exception as e:  # noqa: BLE001 — a dead peer is just stale
+            log.debug("status poll of %s failed: %s", node.ident, e)
+            return None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:  # noqa: BLE001 — the loop must outlive one bad round
+                log.exception("status exchange round failed")
+            await asyncio.sleep(self.poll_interval_s)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._http is not None and not self._http.closed:
+            await self._http.close()

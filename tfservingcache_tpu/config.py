@@ -180,6 +180,42 @@ class DiscoveryConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Fleet status plane (cluster/status.py): the cross-node residency/
+    health exchange the router's p2c tie-breaks and soft route-around
+    consume, surfaced at ``GET /monitoring/cluster``. No reference
+    counterpart — the reference cluster exchanges membership only."""
+
+    # master switch for the exchange (piggyback + poll). Off: the router
+    # falls back to local-only warmth and load-only p2c (pre-PR7 behavior).
+    status_exchange: bool = True
+    # low-rate poll fallback for peers no routed traffic reaches; also the
+    # freshness bar below which a peer is NOT re-polled (piggyback wins)
+    status_poll_interval_s: float = 5.0
+    # a status older than this is stale: its warmth advertisements stop
+    # counting and the peer's health score starts decaying
+    status_stale_after_s: float = 15.0
+    # hard bound on the encoded piggyback payload; encode drops the
+    # coldest models first to fit and stamps how many were cut
+    status_byte_cap: int = 4096
+    # most models a single NodeStatus advertises (warmest win)
+    status_max_models: int = 64
+    # collection cache: piggybacking on every response re-collects at most
+    # this often (a fresh collect is <1 ms, but per-response would still
+    # be wasteful at high QPS)
+    status_min_interval_s: float = 0.25
+    # peers scoring below this are deprioritized in p2c replica ordering
+    # (soft route-around; they stay in the ring and keep their keys)
+    health_threshold: float = 0.5
+    # EWMA weight for forward outcomes (higher = reacts faster, forgets
+    # faster): at 0.3, three straight failures drop health to ~0.34 and
+    # three straight successes recover it past 0.5
+    health_error_alpha: float = 0.3
+    # latency normalization: score factor = ref / (ref + latency_ewma)
+    health_latency_ref_s: float = 1.0
+
+
+@dataclass
 class MeshConfig:
     """TPU chip-group topology — new territory (SURVEY.md §2 parallelism
     inventory: the reference has none). Models larger than one chip are
@@ -254,6 +290,7 @@ class Config:
     proxy: ProxyConfig = field(default_factory=ProxyConfig)
     cache_node: CacheNodePorts = field(default_factory=CacheNodePorts)
     discovery: DiscoveryConfig = field(default_factory=DiscoveryConfig)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)

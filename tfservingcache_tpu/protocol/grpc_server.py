@@ -16,6 +16,7 @@ import time
 
 import grpc
 
+from tfservingcache_tpu.cluster.status import STATUS_TRAILER, STATUS_WANT_METADATA
 from tfservingcache_tpu.protocol.backend import BackendError, ServingBackend
 from tfservingcache_tpu.protocol.protos import grpc_health_pb2 as health_pb
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv
@@ -115,6 +116,10 @@ class GrpcServingServer:
         self._max_message_bytes = max_message_bytes
         self.server: grpc.aio.Server | None = None
         self.port: int | None = None
+        # fleet status plane (cluster/status.py), attached post-construction
+        # by CacheNode: answers the tpusc-status-want metadata marker with a
+        # tpusc-status trailer on routed hops
+        self.status_collector = None
 
     # -- handler plumbing ---------------------------------------------------
     def _unary(self, fn, req_cls, resp_cls):
@@ -125,12 +130,15 @@ class GrpcServingServer:
                 self.metrics.request_count.labels("grpc").inc()
                 self.metrics.requests_in_flight.labels("grpc").inc()
             t0 = time.monotonic()
-            # inbound W3C context from a routing peer (plain metadata key)
+            # inbound W3C context from a routing peer (plain metadata key),
+            # plus the status-exchange want marker (cluster/status.py)
             remote_ctx = None
+            want_status = False
             for key, value in context.invocation_metadata() or ():
                 if key == "traceparent":
                     remote_ctx = parse_traceparent(value)
-                    break
+                elif key == STATUS_WANT_METADATA:
+                    want_status = True
             sp = None
             err: tuple[grpc.StatusCode, str] | None = None
             resp = None
@@ -155,12 +163,19 @@ class GrpcServingServer:
                     self.metrics.request_duration.labels(
                         "grpc", verb, "ok" if err is None else "error", route
                     ).observe(time.monotonic() - t0)
+            # both attachments ride ONE set_trailing_metadata call (grpc.aio
+            # takes the last set, so trailers must be merged, not stacked)
+            trailers: list[tuple[str, str]] = []
             if remote_ctx is not None and sp is not None:
                 # routed hop: return our completed subtree on the trailer so
                 # the router can stitch it (also reaches the client on abort)
-                context.set_trailing_metadata(
-                    ((TRACE_SUBTREE_TRAILER, serialize_span(sp)),)
-                )
+                trailers.append((TRACE_SUBTREE_TRAILER, serialize_span(sp)))
+            if want_status and self.status_collector is not None:
+                blob = self.status_collector.encoded()
+                if blob:
+                    trailers.append((STATUS_TRAILER, blob))
+            if trailers:
+                context.set_trailing_metadata(tuple(trailers))
             if err is not None:
                 await context.abort(err[0], err[1])
             return resp

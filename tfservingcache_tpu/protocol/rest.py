@@ -27,6 +27,7 @@ import time
 
 from aiohttp import web
 
+from tfservingcache_tpu.cluster.status import STATUS_HEADER, STATUS_WANT_HEADER
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
@@ -118,6 +119,12 @@ class RestServingServer:
         self.app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
+        # fleet status plane (cluster/status.py), attached post-construction
+        # by CacheNode/Router when the exchange is on: the collector serves
+        # GET /monitoring/status and the piggyback response header; the
+        # FleetView (router's REST server only) serves /monitoring/cluster
+        self.status_collector = None
+        self.fleet = None
         self._profile_lock = threading.Lock()  # one JAX profile capture at a time
         self.profiler_base_dir = os.environ.get(
             "TPUSC_PROFILER_DIR", "/tmp/tpusc_profile"
@@ -167,12 +174,30 @@ class RestServingServer:
                 )
             # reset-on-scrape watermarks: each GET reports the peak since the
             # previous GET and zeroes the marks; reset=0 peeks without
-            # consuming (OBSERVABILITY.md documents the contract)
+            # consuming (OBSERVABILITY.md documents the contract).
+            # ?model=name@version restricts the per-model sections to one
+            # tenant (unknown model -> empty sections, not 404: the filter
+            # is a view, the resource exists)
             snap = RECORDER.snapshot(
-                tail=max(0, n), reset_watermarks=reset
+                tail=max(0, n), reset_watermarks=reset,
+                model=request.query.get("model"),
             )
             snap["dumps"] = RECORDER.list_dumps()
             return web.json_response(snap)
+        if path == "/monitoring/status":
+            if self.status_collector is None:
+                return web.json_response(
+                    {"error": "status exchange not enabled on this server"},
+                    status=404,
+                )
+            return web.json_response(self.status_collector.collect().to_dict())
+        if path == "/monitoring/cluster":
+            if self.fleet is None:
+                return web.json_response(
+                    {"error": "no fleet view on this server (router only)"},
+                    status=404,
+                )
+            return web.json_response(self.fleet.snapshot())
         if path == "/monitoring/profiler" and request.method == "POST":
             return await self._capture_profile(request)
 
@@ -252,6 +277,16 @@ class RestServingServer:
             # the caller is a router stitching a distributed trace: ship our
             # completed subtree back inline (span closed above, duration set)
             response.headers[TRACE_SUBTREE_HEADER] = serialize_span(sp)
+        if (
+            self.status_collector is not None
+            and request.headers.get(STATUS_WANT_HEADER)
+        ):
+            # routed hop from a status-exchanging router: piggyback this
+            # node's (cached, byte-capped) status on the response — errors
+            # included; a failing response still proves the peer is up
+            blob = self.status_collector.encoded()
+            if blob:
+                response.headers[STATUS_HEADER] = blob
         return response, sp, verb_label
 
     async def _capture_profile(self, request: web.Request) -> web.Response:

@@ -15,6 +15,7 @@ import signal
 from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.manager import CacheManager
 from tfservingcache_tpu.cache.providers import create_provider
+from tfservingcache_tpu.cluster.status import StatusCollector
 from tfservingcache_tpu.config import Config
 from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
 from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
@@ -22,6 +23,7 @@ from tfservingcache_tpu.protocol.rest import RestServingServer
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.net import outbound_ip
 from tfservingcache_tpu.utils.tracing import TRACER
 
 log = get_logger("server")
@@ -43,6 +45,7 @@ class ServingGroup:
         self.grpc = grpc
         self.rest_port = 0
         self.grpc_port = 0
+        self.status: StatusCollector | None = None  # fleet status plane
 
 
 class CacheNode:
@@ -171,7 +174,20 @@ class CacheNode:
             grpc = GrpcServingServer(
                 backend, self.metrics, cfg.proxy.grpc_max_message_bytes
             )
-            self.groups.append(ServingGroup(i, manager, backend, rest, grpc))
+            group = ServingGroup(i, manager, backend, rest, grpc)
+            if cfg.cluster.status_exchange:
+                # per-group status collector for the fleet exchange; built
+                # with a placeholder ident (ports aren't bound yet) that the
+                # Router rebinds to the ring ident once they are
+                group.status = StatusCollector(
+                    f"group{i}", manager, metrics=self.metrics,
+                    byte_cap=cfg.cluster.status_byte_cap,
+                    max_models=cfg.cluster.status_max_models,
+                    min_interval_s=cfg.cluster.status_min_interval_s,
+                )
+                rest.status_collector = group.status
+                grpc.status_collector = group.status
+            self.groups.append(group)
         self._health_task: asyncio.Task | None = None
 
     # group-0 aliases: the single-group shape most callers/tests use
@@ -192,6 +208,13 @@ class CacheNode:
             grpc_base = self.cfg.cache_node.grpc_port
             g.rest_port = await g.rest.start(rest_base + g.index if rest_base else 0)
             g.grpc_port = await g.grpc.start(grpc_base + g.index if grpc_base else 0)
+            if g.status is not None:
+                # rebind the placeholder ident to the ring ident peers will
+                # see — a standalone node (no colocated Router) must still
+                # advertise a routable identity in its piggybacked status
+                host = ("127.0.0.1" if self.cfg.discovery.prefer_localhost
+                        else outbound_ip())
+                g.status.ident = f"{host}:{g.rest_port}:{g.grpc_port}"
         if self.work_server is not None:
             # follower work endpoint: advertised to leaders via
             # mesh.worker_addrs[process_id]

@@ -246,12 +246,49 @@ class FlightRecorder:
             "max_oldest_wait_ms": max((e[11] for e in entries), default=0.0),
         }
 
-    def snapshot(self, tail: int = 64, reset_watermarks: bool = False) -> dict[str, Any]:
+    def engine_stats(self, tail: int = 32) -> dict[str, float]:
+        """Cheap cross-model aggregate for the fleet status plane
+        (cluster/status.py): goodput over the last ``tail`` ring entries,
+        the summed CURRENT queue depth, and the worst current oldest-wait.
+        Unlike snapshot() this builds no per-step dicts — a status
+        collection must stay well under 1 ms (guarded by
+        tests/test_fleet_status.py)."""
+        total = 0
+        wasted = 0
+        depth = 0
+        wait_ms = 0.0
+        for ring in list(self._rings.values()):
+            entries = ring.tail(tail)
+            if not entries:
+                continue
+            total += sum(e[4] * e[3] for e in entries)   # active * chunk
+            wasted += sum(e[9] for e in entries)
+            last = entries[-1]
+            depth += last[10]
+            wait_ms = max(wait_ms, last[11])
+        return {
+            "goodput": (total - wasted) / total if total else 1.0,
+            "queue_depth": depth,
+            "oldest_wait_ms": wait_ms,
+        }
+
+    def snapshot(
+        self,
+        tail: int = 64,
+        reset_watermarks: bool = False,
+        model: str | None = None,
+    ) -> dict[str, Any]:
         """JSON-ready engine state: per-model step window + aggregates,
-        phase notes, watermarks. The ``/monitoring/engine`` payload."""
+        phase notes, watermarks. The ``/monitoring/engine`` payload.
+        ``model`` (the "name@version" ring key) restricts the per-model
+        sections to one tenant — the multi-tenant ?model= filter; an
+        unknown model yields empty sections, not an error."""
         with self._lock:
             rings = dict(self._rings)
             phases = {m: list(dq) for m, dq in self._phases.items()}
+        if model is not None:
+            rings = {m: r for m, r in rings.items() if m == model}
+            phases = {m: p for m, p in phases.items() if m == model}
         models: dict[str, Any] = {}
         for model, ring in rings.items():
             entries = ring.tail(tail)

@@ -311,6 +311,34 @@ class Metrics:
             "1 while the cross-host group serves; 0 while torn down/re-forming",
             ["group"], registry=r,
         )
+        # fleet status plane (cluster/status.py): this node's view of its
+        # peers. health is the router's soft route-around signal (error
+        # EWMA x latency factor x staleness decay); age is how old the
+        # peer's last NodeStatus is; replicas inverts the fleet residency
+        # map ("how many nodes hold model M at tier T"), the input to
+        # ROADMAP item 4's replication decisions. Peer label cardinality is
+        # bounded by ring membership (departed peers are pruned); model
+        # cardinality by cluster.status_max_models per peer.
+        self.peer_health_score = Gauge(
+            "tpusc_peer_health_score",
+            "Composite per-peer health in [0,1] as THIS node scores it: "
+            "forward-error EWMA x latency factor x status-staleness decay "
+            "(peers below cluster.health_threshold are deprioritized in "
+            "p2c replica ordering, never hard-dropped)",
+            ["peer"], registry=r,
+        )
+        self.peer_status_age = Gauge(
+            "tpusc_peer_status_age_seconds",
+            "Seconds since this peer's last NodeStatus was received "
+            "(piggybacked on a routed hop or polled)",
+            ["peer"], registry=r,
+        )
+        self.fleet_model_replicas = Gauge(
+            "tpusc_fleet_model_replicas",
+            "Nodes currently advertising this model at this residency tier "
+            "(tier = hbm | host | disk), from the fleet status exchange",
+            ["model", "tier"], registry=r,
+        )
         self.spec_draft_autodisabled = Counter(
             "tpusc_spec_draft_autodisabled_total",
             "Draft models auto-disabled after sustained low acceptance",

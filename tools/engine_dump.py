@@ -15,9 +15,17 @@ the way an on-call reads it:
   - phase notes: per-request queue/prefill/decode/respond attribution
   - watermarks captured at dump time
 
+With ``--url`` the same rendering runs against a LIVE node: the tool
+fetches ``<url>/monitoring/engine?reset=0`` (peek — it never consumes the
+node's reset-on-scrape watermarks) and renders the response, so the
+on-call can read the current engine state without waiting for an anomaly
+dump. ``--model name@version`` narrows a busy multi-tenant node to one
+model's rings.
+
 Usage:
     python tools/engine_dump.py <dump.json> [--steps N]
     python tools/engine_dump.py --latest [<flight_dir>]
+    python tools/engine_dump.py --url http://node:8501 [--model lm@1]
 """
 
 from __future__ import annotations
@@ -26,8 +34,21 @@ import argparse
 import json
 import os
 import sys
+import urllib.parse
+import urllib.request
 
 DEFAULT_FLIGHT_DIR = "/tmp/tpusc_flight"
+
+
+def fetch(url: str, steps: int, model: str | None = None, timeout: float = 5.0) -> dict:
+    """GET <url>/monitoring/engine as a dump-shaped dict (reset=0: peeking
+    must not consume the node's reset-on-scrape watermarks)."""
+    query = {"n": str(steps), "reset": "0"}
+    if model:
+        query["model"] = model
+    full = f"{url.rstrip('/')}/monitoring/engine?{urllib.parse.urlencode(query)}"
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        return json.load(resp)
 
 
 def _fmt_step(s: dict) -> str:
@@ -143,7 +164,20 @@ def main(argv: list[str] | None = None) -> int:
         "--steps", type=int, default=32,
         help="max timeline rows per model (default 32)",
     )
+    ap.add_argument(
+        "--url",
+        help="render a live node's /monitoring/engine instead of a dump file "
+             "(e.g. http://node:8501; peeks with reset=0)",
+    )
+    ap.add_argument(
+        "--model",
+        help="with --url: restrict to one model (name@version)",
+    )
     args = ap.parse_args(argv)
+    if args.url:
+        dump = fetch(args.url, steps=args.steps, model=args.model)
+        render(dump, max_steps=args.steps)
+        return 0
     path = args.path
     if args.latest:
         path = _latest(path or DEFAULT_FLIGHT_DIR)

@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Terminal viewer for the fleet status plane (``GET /monitoring/cluster``).
+
+Renders the router's FleetView the way an on-call scans a fleet:
+
+  - one row per ring member: health score, status age (with a ``STALE``
+    marker past the staleness horizon), in-flight, queue depth / oldest
+    wait, goodput, KV pages free, host-tier bytes, resident models, and
+    the local forward/failure counts backing the health EWMA;
+  - one row per model: which peers hold it in HBM / host tier / disk
+    (the inverted residency map — "where is model X warm").
+
+Point it at a ROUTER's REST port (the fleet view lives on the router;
+cache-node ports only serve their own ``/monitoring/status``).
+
+Usage:
+    python tools/fleet_top.py http://router:8501
+    python tools/fleet_top.py http://router:8501 --watch 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 5.0) -> dict:
+    full = f"{url.rstrip('/')}/monitoring/cluster"
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def render(snap: dict, out=sys.stdout) -> None:
+    w = out.write
+    nodes = snap.get("nodes") or {}
+    models = snap.get("models") or {}
+    thr = snap.get("health_threshold", 0.5)
+    w(f"=== fleet: {len(nodes)} peers, {len(models)} resident models "
+      f"(health threshold {thr}) ===\n")
+    header = (
+        f"{'peer':<28} {'health':>6} {'age':>7} {'infl':>4} {'queue':>5} "
+        f"{'wait':>8} {'goodput':>7} {'kv free':>9} {'host tier':>9} "
+        f"{'res':>3} {'fwd/fail':>9}\n"
+    )
+    w(header)
+    for ident, row in nodes.items():
+        age = row.get("status_age_s")
+        age_s = "never" if age is None else f"{age:.1f}s"
+        if row.get("stale"):
+            age_s += "!"
+        health = row.get("health", 1.0)
+        mark = " " if health >= thr else "*"  # * = below routing threshold
+        kv_free = row.get("kv_pages_free")
+        kv_total = row.get("kv_pages_total")
+        kv = f"{kv_free}/{kv_total}" if kv_total else "-"
+        w(
+            f"{ident:<28} {health:>5.2f}{mark} {age_s:>7} "
+            f"{row.get('inflight', 0):>4} {row.get('queue_depth', 0):>5} "
+            f"{row.get('oldest_wait_s', 0.0) * 1e3:>6.1f}ms "
+            f"{row.get('goodput', 1.0):>7.3f} {kv:>9} "
+            f"{_fmt_bytes(row.get('host_tier_bytes', 0)):>9} "
+            f"{row.get('models_resident', 0):>3} "
+            f"{row.get('forwards', 0):>4}/{row.get('failures', 0):<4}\n"
+        )
+    if models:
+        w("\nmodel residency (peers per tier):\n")
+        for key in sorted(models):
+            tiers = models[key]
+            name = key.replace("##", "@", 1)
+            parts = []
+            for tier in ("hbm", "host", "disk"):
+                peers = tiers.get(tier) or []
+                if peers:
+                    parts.append(f"{tier}[{','.join(sorted(peers))}]")
+            w(f"  {name:<32} {' '.join(parts) or '(cold everywhere)'}\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="router base URL, e.g. http://router:8501")
+    ap.add_argument(
+        "--watch", type=float, metavar="SECONDS",
+        help="refresh every N seconds (top-style) instead of printing once",
+    )
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            snap = fetch(args.url)
+        except Exception as e:  # noqa: BLE001 — CLI surface: report and retry/exit
+            print(f"fetch {args.url}/monitoring/cluster failed: {e}", file=sys.stderr)
+            if not args.watch:
+                return 1
+            time.sleep(args.watch)
+            continue
+        if args.watch:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        render(snap)
+        if not args.watch:
+            return 0
+        sys.stdout.flush()
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
