@@ -277,7 +277,7 @@ SECTION_GROUPS = (
     "mnist_cold", "lm_cold", "lm_cold_q8", "flash_kernel", "chip_lm",
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
-    "warm_tier", "cold_pipeline", "paged_kv",
+    "warm_tier", "peer_cold_start", "cold_pipeline", "paged_kv",
 )
 
 
@@ -1096,6 +1096,22 @@ COLD_PIPE_NET_MBPS = 30.0
 # fresh cold loads per arm; each family/arm reports its fastest rep
 _COLD_PIPE_REPS = 2
 
+# peer_cold_start preset: fetch-dominated on purpose. A fat embed buys
+# artifact bytes (the thing the peer path accelerates) while 2 narrow
+# layers keep the XLA compile — identical in both arms and paid once in
+# the unmeasured warmup — out of the measured reload window.
+PEER_COLD_LM_CONFIG = {
+    "vocab_size": 65536,
+    "d_model": 768,
+    "n_layers": 2,
+    "n_heads": 12,
+    "n_kv_heads": 6,
+    "d_ff": 1536,
+    "max_seq": 128,
+    "rope_theta": 10000.0,
+    "dtype": "bfloat16",
+}
+
 
 class _NetSimDiskProvider:
     """Wrap a DiskModelProvider with a byte-proportional wire delay.
@@ -1423,6 +1439,180 @@ def bench_warm_tier(tmp: str) -> dict:
     churn["reload_p95_improvement"] = round(
         churn["off"]["reload_p95_ms"] / max(churn["on"]["reload_p95_ms"], 1e-9),
         2,
+    )
+    return out
+
+
+def bench_peer_cold_start(tmp: str) -> dict:
+    """Peer param distribution (cache/providers/peer.py): cold first-predict
+    sourced from the object store at a simulated 30 MB/s vs streamed from a
+    warm peer's host tier over loopback gRPC (ISSUE 8 acceptance: >= 5x).
+    The sender node runs in a separate process: a real peer never shares
+    the receiver's GIL, and colocating both ends made the receiver's hash
+    and scatter work fight the sender's serialization for the lock.
+
+    Both arms use the same transformer_lm preset and the same measurement
+    discipline as warm_tier part 1: compile is paid once in an unmeasured
+    warmup, then each rep evicts the disk artifact (which discards any
+    host-tier entry too — inclusive tiers) and times ensure_servable +
+    first predict. Arms are path-verified through tpusc_reload_source: a
+    rep that did not take its intended source fails the section rather
+    than reporting a meaningless ratio. The per-arm cold_overlap_ratio
+    comes along because the peer stream lands model.json FIRST — the
+    receiver keeps the same fetch/compile overlap the store path gets."""
+    from types import SimpleNamespace
+
+    from tfservingcache_tpu.cache.providers.peer import PeerProvider
+    from tfservingcache_tpu.cluster.status import FleetView, NodeStatus
+    from tfservingcache_tpu.types import ModelId, NodeInfo
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    reps = 3
+    mid = ModelId("tenant0", 1)
+    inputs = _example_inputs("transformer_lm", 1, PEER_COLD_LM_CONFIG, lm_seq=1)
+    out: dict = {"family": "transformer_lm", "net_mbps": COLD_PIPE_NET_MBPS,
+                 "reps": reps}
+
+    def _arm(manager, runtime, metrics, tier_name: str) -> dict:
+        def _src() -> float:
+            return metrics.reload_source.labels(tier_name)._value.get()
+
+        def _overlap() -> tuple[float, float]:
+            g = metrics.registry.get_sample_value
+            return (g("tpusc_cold_overlap_ratio_sum") or 0.0,
+                    g("tpusc_cold_overlap_ratio_count") or 0.0)
+
+        manager.ensure_servable(mid)       # compile + caches, unmeasured
+        runtime.predict(mid, inputs)
+        s0, c0 = _overlap()
+        lats = []
+        for _ in range(reps):
+            before = _src()
+            manager.disk_cache.remove(mid)
+            manager.disk_cache.drain_evictions()
+            runtime.drain_demotions()
+            t0 = time.perf_counter()
+            manager.ensure_servable(mid)
+            runtime.predict(mid, inputs)
+            lats.append(time.perf_counter() - t0)
+            if _src() != before + 1:
+                raise RuntimeError(
+                    f"peer_cold_start {tier_name} arm did not take the "
+                    f"{tier_name} path — reload_source says otherwise"
+                )
+        s1, c1 = _overlap()
+        lats.sort()
+        # the peer arm legitimately records no cold-stage samples: it
+        # promotes from the wire-adopted packed entry, so there is no
+        # staged fetch/compile pipeline to overlap — report null, not 0
+        return {
+            "first_predict_p50_s": round(lats[len(lats) // 2], 3),
+            "cold_overlap_ratio": (
+                round((s1 - s0) / (c1 - c0), 2) if c1 > c0 else None
+            ),
+        }
+
+    # -- store arm: 30 MB/s simulated object-store wire ----------------------
+    m_store = Metrics()
+    manager, runtime = _make_stack(
+        "transformer_lm", 1, os.path.join(tmp, "pcs-store"),
+        config=PEER_COLD_LM_CONFIG, metrics=m_store,
+    )
+    manager.provider = _NetSimDiskProvider(manager.provider, COLD_PIPE_NET_MBPS)
+    out["store"] = _arm(manager, runtime, m_store, "store")
+    manager.close()
+
+    # -- peer arm: warm sender in a SUBPROCESS, cold receiver here -----------
+    # separate process on purpose: a real peer never shares the receiver's
+    # GIL, and colocating both ends makes the stream's hash + scatter fight
+    # the sender's serialization for the same interpreter lock
+    import subprocess
+    import sys
+
+    sender_store = os.path.join(tmp, "pcs-store", "store-transformer_lm")
+    sender_src = (
+        "import asyncio, os, sys\n"
+        "from types import SimpleNamespace\n"
+        "from tfservingcache_tpu.cache.disk_cache import ModelDiskCache\n"
+        "from tfservingcache_tpu.cache.host_tier import HostRamTier\n"
+        "from tfservingcache_tpu.cache.manager import CacheManager\n"
+        "from tfservingcache_tpu.cache.providers.disk import DiskModelProvider\n"
+        "from tfservingcache_tpu.models.registry import load_artifact\n"
+        "from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer\n"
+        "from tfservingcache_tpu.protocol.local_backend import LocalServingBackend\n"
+        "from tfservingcache_tpu.protocol.peer_transfer import PeerSource\n"
+        "from tfservingcache_tpu.runtime.fake import FakeRuntime\n"
+        "from tfservingcache_tpu.runtime.model_runtime import build_packed_entry\n"
+        "from tfservingcache_tpu.types import ModelId\n"
+        "store, cache_dir = sys.argv[1], sys.argv[2]\n"
+        "md, params = load_artifact(os.path.join(store, 'tenant0', '1'),\n"
+        "                           raw_quant=True)\n"
+        "entry = build_packed_entry(md, params, jitted=None, hbm_bytes=0)\n"
+        "tier = HostRamTier(1 << 31)\n"
+        "tier.put(ModelId('tenant0', 1), entry)\n"
+        "async def main():\n"
+        "    mgr = CacheManager(DiskModelProvider(store),\n"
+        "                       ModelDiskCache(cache_dir, 1 << 31), FakeRuntime())\n"
+        "    srv = GrpcServingServer(LocalServingBackend(mgr))\n"
+        "    srv.peer_source = PeerSource(SimpleNamespace(_host_tier=tier),\n"
+        "                                 chunk_bytes=4 << 20)\n"
+        "    port = await srv.start(0, host='127.0.0.1')\n"
+        "    print(f'READY {port} {entry.nbytes}', flush=True)\n"
+        "    await asyncio.Event().wait()\n"
+        "asyncio.run(main())\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", sender_src, sender_store,
+         os.path.join(tmp, "pcs-a-cache")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+    )
+    m_peer = Metrics()
+    peer_provider = None
+    try:
+        ready = ""
+        t_wait = time.monotonic()
+        while not ready.startswith("READY"):
+            if proc.poll() is not None or time.monotonic() - t_wait > 120:
+                raise RuntimeError("peer_cold_start: sender process never came up")
+            ready = proc.stdout.readline().strip()
+        _, gport, entry_nbytes = ready.split()
+        out["sender_entry_mb"] = round(int(entry_nbytes) / (1 << 20), 1)
+
+        mgr_b, rt_b = _make_stack(
+            "transformer_lm", 1, os.path.join(tmp, "pcs-b"),
+            config=PEER_COLD_LM_CONFIG, metrics=m_peer,
+        )
+        info_a = NodeInfo("127.0.0.1", 1, int(gport))
+        fleet = FleetView()
+        fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+        # the receiver's FALLBACK is the same 30 MB/s store — only the peer
+        # stream may beat it, and the path check above proves it did
+        peer_provider = PeerProvider(
+            _NetSimDiskProvider(mgr_b.provider, COLD_PIPE_NET_MBPS)
+        )
+        peer_provider.bind_fleet(
+            fleet, SimpleNamespace(_nodes_by_ident={info_a.ident: info_a}),
+            set(),
+        )
+        mgr_b.provider = peer_provider
+        try:
+            out["peer"] = _arm(mgr_b, rt_b, m_peer, "peer")
+        finally:
+            mgr_b.close()
+    finally:
+        if peer_provider is not None:
+            peer_provider.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+    out["speedup"] = round(
+        out["store"]["first_predict_p50_s"]
+        / max(out["peer"]["first_predict_p50_s"], 1e-9),
+        1,
     )
     return out
 
@@ -2336,6 +2526,15 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["warm_tier"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("peer_cold_start"):
+        try:
+            with _section("peer_cold_start"):
+                detail["peer_cold_start"] = bench_peer_cold_start(
+                    os.path.join(tmp, "peercold")
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["peer_cold_start"] = {"error": f"{type(e).__name__}: {e}"}
 
     # LAST: this section calls jax.clear_caches() per arm, which would force
     # recompiles under any later section's measured window

@@ -1,0 +1,635 @@
+"""Peer param distribution (ISSUE 8): the FetchPackedModel wire format
+round-trips byte-exact (float and int8), host-tier pins survive concurrent
+eviction without perturbing LRU order, a peer NOT_FOUND is a clean miss
+that falls back to the store, the two-node e2e sources a cold load from a
+warm peer over real gRPC, a mid-stream peer death degrades to the store
+without failing the request, and the load-adaptive ReplicaController grows
+fast / shrinks with hysteresis so an oscillating load cannot flap the
+ring."""
+
+import asyncio
+import dataclasses
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+from tfservingcache_tpu.cache.host_tier import HostRamTier, PackedModelEntry
+from tfservingcache_tpu.cache.manager import CacheManager
+from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+from tfservingcache_tpu.cache.providers.peer import PeerProvider
+from tfservingcache_tpu.cluster.hashring import HashRing
+from tfservingcache_tpu.cluster.replication import ReplicaController
+from tfservingcache_tpu.cluster.status import FleetView, NodeStatus
+from tfservingcache_tpu.models.registry import (
+    QuantLeaf,
+    export_artifact,
+    load_artifact,
+)
+from tfservingcache_tpu.protocol import peer_transfer
+from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
+from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+from tfservingcache_tpu.protocol.peer_transfer import (
+    PeerSource,
+    PeerStreamReceiver,
+    PeerWireError,
+    build_wire_meta,
+    iter_frames,
+)
+from tfservingcache_tpu.runtime.fake import FakeRuntime
+from tfservingcache_tpu.runtime.model_runtime import build_packed_entry
+from tfservingcache_tpu.types import ModelId, NodeInfo
+from tfservingcache_tpu.utils.metrics import Metrics
+from tfservingcache_tpu.utils.tracing import TRACER
+
+PLAIN_CFG = {"vocab_size": 512, "d_model": 128, "n_layers": 1, "n_heads": 2,
+             "n_kv_heads": 1, "d_ff": 128, "max_seq": 32, "dtype": "float32"}
+# embed (512x128 = 65536 elements) crosses the int8 eligibility floor
+QUANT_CFG = {"vocab_size": 512, "d_model": 128, "n_layers": 1, "n_heads": 2,
+             "n_kv_heads": 1, "d_ff": 256, "max_seq": 32, "dtype": "bfloat16"}
+
+
+def _sample(metrics, name, **labels):
+    return metrics.registry.get_sample_value(name, labels or None)
+
+
+def _entry_for(artifact_path):
+    md, params = load_artifact(artifact_path, raw_quant=True)
+    return build_packed_entry(md, params, jitted=None, hbm_bytes=0), params
+
+
+def _as_u8(a):
+    return np.asarray(a).reshape(-1).view(np.uint8)
+
+
+def _assert_byte_exact(want, got):
+    import jax
+
+    is_ql = lambda x: isinstance(x, QuantLeaf)  # noqa: E731
+    lw = jax.tree_util.tree_leaves(want, is_leaf=is_ql)
+    lg = jax.tree_util.tree_leaves(got, is_leaf=is_ql)
+    assert len(lw) == len(lg)
+    for a, b in zip(lw, lg):
+        if isinstance(a, QuantLeaf):
+            assert isinstance(b, QuantLeaf)
+            assert a.orig_dtype == b.orig_dtype
+            assert np.asarray(b.q).dtype == np.int8
+            assert np.array_equal(np.asarray(a.q), np.asarray(b.q))
+            assert np.asarray(a.scale).dtype == np.asarray(b.scale).dtype
+            assert np.array_equal(_as_u8(a.scale), _as_u8(b.scale))
+        else:
+            assert np.asarray(a).dtype == np.asarray(b).dtype
+            assert np.asarray(a).shape == np.asarray(b).shape
+            assert np.array_equal(_as_u8(a), _as_u8(b))
+
+
+def _span_names(span):
+    yield span["name"]
+    for c in span.get("children", []):
+        yield from _span_names(c)
+
+
+# -- wire format --------------------------------------------------------------
+
+@pytest.mark.parametrize("quantize", [None, "int8"])
+def test_wire_roundtrip_byte_exact(tmp_path, quantize):
+    """iter_frames -> PeerStreamReceiver lands a loadable v2 artifact whose
+    leaves are byte-identical to the sender's — for plain float trees and
+    for int8 QuantLeaf trees (q, scale, and orig_dtype all preserved)."""
+    cfg = QUANT_CFG if quantize else PLAIN_CFG
+    src = export_artifact("transformer_lm", str(tmp_path / "store"), name="m",
+                          version=1, seed=0, config=cfg, quantize=quantize)
+    entry, src_params = _entry_for(src)
+    dest = str(tmp_path / "rx")
+    rx = PeerStreamReceiver(dest, assemble=True)
+    kinds = [rx.feed(f) for f in iter_frames(entry, 64 << 10,
+                                             model_id=ModelId("m", 1))]
+    assert kinds[0] == "meta" and kinds[-1] == "end"
+    assert kinds.count("chunk") >= 1
+    assert rx.bytes_received == entry.nbytes
+    md, got_params = load_artifact(dest, raw_quant=True)
+    assert md.family == "transformer_lm"
+    _assert_byte_exact(src_params, got_params)
+    # the RAM-assembled entry must replicate the sender's pack layout
+    # exactly — it's what the receiving runtime promotes from
+    rebuilt = rx.build_entry()
+    assert rebuilt.paths == entry.paths
+    assert rebuilt.owner == entry.owner
+    assert rebuilt.shapes == entry.shapes
+    assert rebuilt.quant_dtypes == entry.quant_dtypes
+    assert len(rebuilt.chunks) == len(entry.chunks)
+    for (plan_a, buf_a), (plan_b, buf_b) in zip(entry.chunks, rebuilt.chunks):
+        assert plan_a == plan_b
+        assert buf_a.dtype == buf_b.dtype
+        assert np.array_equal(_as_u8(buf_a), _as_u8(buf_b))
+
+
+def test_wire_receiver_rejects_corruption(tmp_path):
+    src = export_artifact("transformer_lm", str(tmp_path / "store"), name="m",
+                          version=1, seed=0, config=PLAIN_CFG)
+    entry, _ = _entry_for(src)
+    frames = list(iter_frames(entry, 64 << 10))
+    chunk_idx = [i for i, f in enumerate(frames)
+                 if f[0] == peer_transfer.FRAME_CHUNK]
+    assert len(chunk_idx) >= 2  # the test needs a genuinely multi-frame chunk
+
+    # out-of-order data frame
+    rx = PeerStreamReceiver(str(tmp_path / "rx1"))
+    rx.feed(frames[0])
+    with pytest.raises(PeerWireError, match="out-of-order"):
+        rx.feed(frames[chunk_idx[1]])
+    rx.close()
+
+    # flipped payload byte -> hash mismatch at chunk completion
+    rx = PeerStreamReceiver(str(tmp_path / "rx2"))
+    with pytest.raises(PeerWireError, match="hash mismatch"):
+        for i, f in enumerate(frames):
+            if i == chunk_idx[-1]:
+                f = f[:-1] + bytes([f[-1] ^ 0xFF])
+            rx.feed(f)
+    rx.close()
+
+    # end frame with chunks still missing
+    rx = PeerStreamReceiver(str(tmp_path / "rx3"))
+    rx.feed(frames[0])
+    with pytest.raises(PeerWireError, match="incomplete"):
+        rx.feed(frames[-1])
+    rx.close()
+
+    # a pre-PR8 entry (no leaf-path map) cannot be served at all
+    bare = dataclasses.replace(entry, paths=[])
+    with pytest.raises(PeerWireError, match="leaf-path map"):
+        build_wire_meta(bare)
+
+
+def test_adopted_entry_promotes_without_artifact_read(tmp_path):
+    """A wire-adopted packed entry serves the first load via the promotion
+    path — provably without touching the artifact (the Model handed to the
+    runtime points at a directory that does not exist) — is consumed
+    exactly once, and predicts byte-identically to a plain disk load."""
+    from tfservingcache_tpu.config import ServingConfig
+    from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+    from tfservingcache_tpu.types import Model
+
+    src = export_artifact("transformer_lm", str(tmp_path / "store"), name="m",
+                          version=1, seed=3, config=PLAIN_CFG)
+    entry, _ = _entry_for(src)
+    mid = ModelId("m", 1)
+    x = {"input_ids": np.arange(8, dtype=np.int32).reshape(1, 8)}
+    rt = TPUModelRuntime(ServingConfig(hbm_capacity_bytes=1 << 30))
+    try:
+        rt.adopt_packed_entry(mid, entry)
+        ghost = Model(identifier=mid, path=str(tmp_path / "ghost"),
+                      size_on_disk=0)
+        assert rt.ensure_loaded(ghost) == "host"
+        got = rt.predict(mid, x)
+        rt.unload(mid)
+        # one-shot: the next load finds no adopted entry and reads disk
+        real = Model(identifier=mid, path=src, size_on_disk=0)
+        assert rt.ensure_loaded(real) == "disk"
+        want = rt.predict(mid, x)
+        assert set(want) == set(got)
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(want[k]),
+                                          np.asarray(got[k]))
+    finally:
+        rt.close()
+
+
+# -- host-tier pinning (satellite 1) ------------------------------------------
+
+def _dummy_entry(nbytes, seed=0):
+    buf = (np.arange(nbytes, dtype=np.int64) + seed).astype(np.uint8)
+    return PackedModelEntry(
+        model_def=None, chunks=[([0], buf)], owner=[(0, "plain")],
+        shapes=[(nbytes,)], quant_dtypes={}, treedef=None, jitted=None,
+        nbytes=nbytes, paths=["w"],
+    )
+
+
+def test_pin_survives_eviction_without_touching_lru():
+    metrics = Metrics()
+    tier = HostRamTier(capacity_bytes=256, metrics=metrics)
+    m1, m2, m3 = ModelId("a", 1), ModelId("b", 1), ModelId("c", 1)
+    tier.put(m1, _dummy_entry(100, 1))
+    tier.put(m2, _dummy_entry(100, 2))
+
+    assert tier.pin(ModelId("absent", 1)) is None  # clean miss
+    pinned = tier.pin(m1)
+    assert pinned is not None
+
+    # the pin did NOT touch m1 to MRU: the next put still evicts m1 first
+    evicted = tier.put(m3, _dummy_entry(100, 3))
+    assert evicted == [m1]
+    assert tier.get(m1, touch=False) is None and m2 in tier and m3 in tier
+
+    # the evicted-but-pinned entry stays valid and stays accounted
+    assert pinned.chunks[0][1][0] == 1
+    assert _sample(metrics, "tpusc_host_tier_bytes") == 300
+    assert _sample(metrics, "tpusc_evictions_total", tier="host") == 1
+
+    # refcounted: a second pin holds the stash through the first unpin
+    assert tier.pin(m1) is pinned
+    tier.unpin(m1)
+    assert tier._pinned_evicted, "stash freed while a pin remained"
+    tier.unpin(m1)
+    assert tier._pins == {} and tier._pinned_evicted == {}
+    assert _sample(metrics, "tpusc_host_tier_bytes") == 200
+
+
+def test_peer_source_inflight_cap():
+    src = PeerSource(SimpleNamespace(), max_inflight_per_peer=2)
+    assert src.acquire("10.0.0.2") and src.acquire("10.0.0.2")
+    assert not src.acquire("10.0.0.2")       # at cap
+    assert src.acquire("10.0.0.3")           # caps are per requesting host
+    src.release("10.0.0.2")
+    assert src.acquire("10.0.0.2")
+    # a runtime without a host tier never serves (pin is a clean None)
+    assert src.pin(ModelId("m", 1)) is None
+
+
+# -- two-node e2e over real gRPC ----------------------------------------------
+
+async def _sender_node(tmp_path, store, metrics=None, capacity=1 << 30,
+                       chunk_bytes=64 << 10, max_inflight=2):
+    """Node A: a gRPC server whose PeerSource serves a real HostRamTier
+    (the CacheNode wiring, built by hand so tests control the tier)."""
+    tier = HostRamTier(capacity_bytes=capacity, metrics=metrics)
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache_sender"), capacity_bytes=1 << 30),
+        FakeRuntime(),
+    )
+    backend = LocalServingBackend(manager)
+    srv = GrpcServingServer(backend)
+    srv.peer_source = PeerSource(
+        SimpleNamespace(_host_tier=tier),
+        chunk_bytes=chunk_bytes, max_inflight_per_peer=max_inflight,
+    )
+    gport = await srv.start(0, host="127.0.0.1")
+    info = NodeInfo("127.0.0.1", 1, gport)
+
+    async def close():
+        await srv.close()
+        backend.close()
+        manager.close()
+
+    return tier, srv, info, close
+
+
+def _cold_node(tmp_path, store, fleet, nodes, metrics):
+    """Node B: a cold CacheManager whose provider tries peers first."""
+    provider = PeerProvider(DiskModelProvider(str(store)),
+                            chunk_bytes=64 << 10, timeout_s=10.0)
+    provider.bind_fleet(fleet, SimpleNamespace(
+        _nodes_by_ident={n.ident: n for n in nodes}), set())
+    cache = ModelDiskCache(str(tmp_path / "cache_cold"), capacity_bytes=1 << 30)
+    manager = CacheManager(provider, cache, FakeRuntime(), metrics)
+    return provider, cache, manager
+
+
+@pytest.mark.parametrize("quantize", [None, "int8"])
+async def test_two_node_peer_cold_load_e2e(tmp_path, quantize):
+    """Acceptance e2e: node A holds the packed model in its host tier; node
+    B's cold miss streams it from A over real gRPC — byte-exact parity with
+    the store artifact (incl. int8), reload_source says peer, the trace
+    shows the peer_fetch hop, and A releases every pin."""
+    cfg = QUANT_CFG if quantize else PLAIN_CFG
+    store = tmp_path / "store"
+    src = export_artifact("transformer_lm", str(store), name="m", version=1,
+                          seed=0, config=cfg, quantize=quantize)
+    mid = ModelId("m", 1)
+    entry, src_params = _entry_for(src)
+    tier, srv, info_a, close_a = await _sender_node(tmp_path, store)
+    tier.put(mid, entry)
+
+    metrics_b = Metrics()
+    fleet = FleetView(metrics=metrics_b)
+    fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+    provider, cache_b, manager_b = _cold_node(
+        tmp_path, store, fleet, [info_a], metrics_b)
+    try:
+        TRACER.clear()
+        # ensure_servable blocks; A's aio server needs the loop running
+        model = await asyncio.to_thread(manager_b.ensure_servable, mid)
+        assert model.metadata["fetch_source"] == "peer"
+        assert model.metadata["fetch_peer"] == info_a.ident
+        # the manager must pop the wire-rebuilt entry (adopted or dropped):
+        # a Model lives in the disk-cache map for as long as the artifact
+        # stays cached, and a retained entry would pin the packed bytes
+        assert "packed_entry" not in model.metadata
+        assert _sample(metrics_b, "tpusc_reload_source_total", tier="peer") == 1
+        assert _sample(metrics_b, "tpusc_reload_source_total", tier="store") is None
+        assert _sample(metrics_b, "tpusc_peer_fetch_bytes_total",
+                       outcome="ok") == entry.nbytes
+
+        _, got_params = load_artifact(cache_b.model_path(mid), raw_quant=True)
+        _assert_byte_exact(src_params, got_params)
+
+        seen = [n for t in TRACER.recent(8) for n in _span_names(t)]
+        assert "peer_fetch" in seen
+        assert fleet._peers[info_a.ident].forwards == 1
+        assert fleet.health(info_a.ident) > fleet.health_threshold
+        assert tier._pins == {} and tier._pinned_evicted == {}
+    finally:
+        provider.close()
+        manager_b.close()
+        await close_a()
+
+
+async def test_peer_not_found_is_clean_miss_then_store(tmp_path):
+    """Satellite 2: a stale advertisement (peer evicted since) answers
+    NOT_FOUND — the asker counts it as a forward SUCCESS (the connection
+    proved liveness) and completes from the store."""
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="m", version=1,
+                    seed=0, config=PLAIN_CFG)
+    mid = ModelId("m", 1)
+    # sender's host tier is EMPTY: the fleet advert below is stale
+    _tier, srv, info_a, close_a = await _sender_node(tmp_path, store)
+
+    metrics_b = Metrics()
+    fleet = FleetView(metrics=metrics_b)
+    fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+    provider, cache_b, manager_b = _cold_node(
+        tmp_path, store, fleet, [info_a], metrics_b)
+    try:
+        model = await asyncio.to_thread(manager_b.ensure_servable, mid)
+        assert "fetch_source" not in model.metadata
+        assert _sample(metrics_b, "tpusc_reload_source_total", tier="store") == 1
+        assert _sample(metrics_b, "tpusc_reload_source_total", tier="peer") is None
+        assert _sample(metrics_b, "tpusc_peer_fetch_bytes_total",
+                       outcome="not_found") == 0
+        # clean miss != failure: the peer's health ledger records a success
+        assert fleet._peers[info_a.ident].forwards == 1
+        assert fleet.health(info_a.ident) > fleet.health_threshold
+    finally:
+        provider.close()
+        manager_b.close()
+        await close_a()
+
+
+async def test_peer_at_stream_cap_falls_back_without_penalty(tmp_path):
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="m", version=1,
+                    seed=0, config=PLAIN_CFG)
+    mid = ModelId("m", 1)
+    tier, srv, info_a, close_a = await _sender_node(
+        tmp_path, store, max_inflight=0)  # every stream is over the cap
+    tier.put(mid, _entry_for(str(store / "m" / "1"))[0])
+
+    metrics_b = Metrics()
+    fleet = FleetView(metrics=metrics_b)
+    fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+    provider, _cache, manager_b = _cold_node(
+        tmp_path, store, fleet, [info_a], metrics_b)
+    try:
+        await asyncio.to_thread(manager_b.ensure_servable, mid)
+        assert _sample(metrics_b, "tpusc_reload_source_total", tier="store") == 1
+        # alive-but-busy is not a failure: health stays over the threshold
+        assert fleet.health(info_a.ident) > fleet.health_threshold
+    finally:
+        provider.close()
+        manager_b.close()
+        await close_a()
+
+
+async def test_outbound_stream_survives_concurrent_eviction(tmp_path):
+    """Satellite 1 e2e: A's tier evicts the model MID-STREAM (capacity
+    pressure); the pinned snapshot keeps the stream byte-exact and the
+    stash frees on unpin."""
+    store = tmp_path / "store"
+    src = export_artifact("transformer_lm", str(store), name="m", version=1,
+                          seed=0, config=PLAIN_CFG)
+    mid = ModelId("m", 1)
+    entry, src_params = _entry_for(src)
+    metrics_a = Metrics()
+    # capacity exactly one entry: the mid-stream filler put MUST evict m
+    tier, srv, info_a, close_a = await _sender_node(
+        tmp_path, store, metrics=metrics_a, capacity=entry.nbytes)
+    tier.put(mid, entry)
+
+    real_iter = peer_transfer.iter_frames
+
+    def evicting_iter(entry_, chunk_bytes, model_id=None):
+        first = True
+        for frame in real_iter(entry_, chunk_bytes, model_id=model_id):
+            yield frame
+            if first:
+                first = False
+                tier.put(ModelId("filler", 1), _dummy_entry(64, 9))
+                assert tier.get(mid, touch=False) is None
+
+    peer_transfer.iter_frames = evicting_iter
+    metrics_b = Metrics()
+    fleet = FleetView(metrics=metrics_b)
+    fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+    provider, cache_b, manager_b = _cold_node(
+        tmp_path, store, fleet, [info_a], metrics_b)
+    try:
+        model = await asyncio.to_thread(manager_b.ensure_servable, mid)
+        assert model.metadata["fetch_source"] == "peer"
+        _, got_params = load_artifact(cache_b.model_path(mid), raw_quant=True)
+        _assert_byte_exact(src_params, got_params)
+        assert _sample(metrics_a, "tpusc_evictions_total", tier="host") == 1
+        assert tier._pins == {} and tier._pinned_evicted == {}
+    finally:
+        peer_transfer.iter_frames = real_iter
+        provider.close()
+        manager_b.close()
+        await close_a()
+
+
+async def test_midstream_peer_death_degrades_to_store(tmp_path):
+    """Acceptance: the peer dies after the stream started — the request
+    still completes (store fallback), the failure is counted and the
+    peer's health is penalized."""
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="m", version=1,
+                    seed=0, config=PLAIN_CFG)
+    mid = ModelId("m", 1)
+    tier, srv, info_a, close_a = await _sender_node(tmp_path, store)
+    tier.put(mid, _entry_for(str(store / "m" / "1"))[0])
+
+    real_iter = peer_transfer.iter_frames
+
+    def dying_iter(entry_, chunk_bytes, model_id=None):
+        it = real_iter(entry_, chunk_bytes, model_id=model_id)
+        yield next(it)   # meta lands...
+        yield next(it)   # ...and some payload
+        raise RuntimeError("simulated peer crash mid-stream")
+
+    peer_transfer.iter_frames = dying_iter
+    metrics_b = Metrics()
+    fleet = FleetView(metrics=metrics_b)
+    fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+    provider, cache_b, manager_b = _cold_node(
+        tmp_path, store, fleet, [info_a], metrics_b)
+    try:
+        model = await asyncio.to_thread(manager_b.ensure_servable, mid)
+        assert "fetch_source" not in model.metadata  # the store served it
+        assert _sample(metrics_b, "tpusc_reload_source_total", tier="store") == 1
+        assert _sample(metrics_b, "tpusc_peer_fetch_bytes_total",
+                       outcome="error") is not None
+        assert fleet.health(info_a.ident) < 1.0  # mid-stream death penalized
+        # the fallback artifact is complete and loadable
+        load_artifact(cache_b.model_path(mid))
+    finally:
+        peer_transfer.iter_frames = real_iter
+        provider.close()
+        manager_b.close()
+        await close_a()
+
+
+# -- load-adaptive replication ------------------------------------------------
+
+def _ring_cluster(n=6):
+    ring = HashRing()
+    ring.set_members([f"10.0.0.{i}:1:{i}" for i in range(n)])
+    return SimpleNamespace(ring=ring)
+
+
+def test_replica_controller_grows_fast_shrinks_with_hysteresis():
+    cluster = _ring_cluster()
+    metrics = Metrics()
+    ctl = ReplicaController(cluster, base_replicas=1, max_replicas=3,
+                            load_target=2.0, decay_ticks=3, metrics=metrics)
+    key = "hot##1"
+    assert ctl.replicas_for(key) == 1  # unknown keys sit at the floor
+
+    for _ in range(8):
+        ctl.note_start(key)
+    assert ctl.evaluate()[key] == 2          # ewma 4.0 -> ceil(4/2)
+    assert ctl.evaluate()[key] == 3          # sustained load -> cap
+    assert _sample(metrics, "tpusc_model_replicas_target", model=key) == 3
+
+    # ring prefix stability: growing N never remaps the existing replicas
+    r1, r3 = cluster.ring.get_n(key, 1), cluster.ring.get_n(key, 3)
+    assert r3[:1] == r1 and len(set(r3)) == 3
+
+    for _ in range(8):
+        ctl.note_end(key)
+    # hysteresis: two low ticks do NOT shrink...
+    ctl.evaluate()  # absorbs the pre-drain peak
+    low1, low2 = ctl.evaluate()[key], ctl.evaluate()[key]
+    assert (low1, low2) == (3, 3)
+    # ...and a load burst resets the decay counter (no flap near threshold)
+    for _ in range(8):
+        ctl.note_start(key)
+    assert ctl.evaluate()[key] == 3
+    for _ in range(8):
+        ctl.note_end(key)
+
+    # only a SUSTAINED lull shrinks, and an idle key is pruned entirely
+    for _ in range(20):
+        targets = ctl.evaluate()
+        if key not in targets:
+            break
+    else:
+        pytest.fail(f"idle key never pruned: {targets}")
+    assert ctl.replicas_for(key) == 1
+    assert _sample(metrics, "tpusc_model_replicas_target", model=key) is None
+
+
+def test_replica_growth_warms_new_local_replicas():
+    cluster = _ring_cluster(4)
+    members = sorted(cluster.ring.members)
+
+    class _Recorder:
+        def __init__(self):
+            self.calls = []
+
+        def ensure_servable(self, mid):
+            self.calls.append(mid)
+
+    recorders = {m: _Recorder() for m in members}
+    ctl = ReplicaController(cluster, base_replicas=1, max_replicas=2,
+                            load_target=1.0, decay_ticks=2,
+                            local_managers=recorders)
+    key = "m##1"
+    for _ in range(4):
+        ctl.note_start(key)
+    assert ctl.evaluate()[key] == 2
+    idents = cluster.ring.get_n(key, 2)
+    deadline = time.monotonic() + 5.0
+    while not recorders[idents[1]].calls and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # exactly the NEW replica is warmed; the incumbent is left alone
+    assert recorders[idents[1]].calls == [ModelId("m", 1)]
+    assert recorders[idents[0]].calls == []
+
+
+async def test_find_nodes_for_key_honors_replica_hook():
+    from tfservingcache_tpu.cluster.cluster import ClusterConnection
+    from tests.test_cluster import DiscoveryServiceMock, nodes_list
+
+    mock = DiscoveryServiceMock()
+    cluster = ClusterConnection(mock, replicas_per_model=1)
+    connect = asyncio.create_task(
+        cluster.connect(NodeInfo("10.0.0.9", 1, 1), lambda: True, wait_ready_s=2)
+    )
+    await asyncio.sleep(0.05)
+    mock.push(nodes_list(4))
+    await connect
+    try:
+        assert len(cluster.find_nodes_for_key("m##1")) == 1
+        cluster.replicas_for_key = lambda key: 3
+        grown = cluster.find_nodes_for_key("m##1")
+        assert len(grown) == 3
+        # shrink keeps serving through the surviving prefix
+        cluster.replicas_for_key = lambda key: 1
+        assert cluster.find_nodes_for_key("m##1")[0].ident == grown[0].ident
+        # a broken hook falls back to the static default, never fails routing
+        cluster.replicas_for_key = lambda key: 1 / 0
+        assert len(cluster.find_nodes_for_key("m##1")) == 1
+    finally:
+        await cluster.disconnect()
+
+
+async def test_routing_backend_feeds_demand_notes(tmp_path):
+    """Every routed request brackets the per-key demand signal — balanced
+    start/end pairs even when the backend errors."""
+    from tfservingcache_tpu.cluster.cluster import ClusterConnection
+    from tfservingcache_tpu.cluster.router import RoutingBackend
+    from tests.test_cluster import DiscoveryServiceMock, make_store
+
+    store = tmp_path / "store"
+    make_store(store, [("m", 1)])
+    cache = ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 20)
+    manager = CacheManager(DiskModelProvider(str(store)), cache, FakeRuntime())
+    backend = LocalServingBackend(manager)
+    info = NodeInfo("127.0.0.1", 1, 2)
+    mock = DiscoveryServiceMock()
+    cluster = ClusterConnection(mock, replicas_per_model=1)
+    connect = asyncio.create_task(
+        cluster.connect(info, lambda: True, wait_ready_s=2)
+    )
+    await asyncio.sleep(0.05)
+    mock.push([info])
+    await connect
+
+    events = []
+    routing = RoutingBackend(cluster, {info.ident: backend})
+    routing.demand = SimpleNamespace(
+        note_start=lambda key: events.append(("start", key)),
+        note_end=lambda key: events.append(("end", key)),
+    )
+    try:
+        resp = await routing.handle_rest(
+            "POST", "m", 1, "predict", b'{"instances": [2.0]}'
+        )
+        assert resp.status == 200
+        assert events == [("start", "m##1"), ("end", "m##1")]
+        events.clear()
+        from tfservingcache_tpu.protocol.backend import BackendError
+
+        with pytest.raises(BackendError):
+            await routing.handle_rest("POST", "nosuch", 1, "predict", b"{}")
+        assert events == [("start", "nosuch##1"), ("end", "nosuch##1")]
+    finally:
+        await routing.close()
+        await cluster.disconnect()
+        backend.close()
+        manager.close()

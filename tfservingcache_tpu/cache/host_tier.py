@@ -60,6 +60,17 @@ class PackedModelEntry:
     aot_entries: dict = field(default_factory=dict)
     hbm_bytes: int = 0
     nbytes: int = 0
+    # outer idx -> artifact leaf path ("/"-joined key path, registry
+    # _leaf_path_str convention). Lets a peer synthesize a complete v2
+    # model.json + manifest purely from this entry when streaming it over
+    # the wire (protocol/peer_transfer.py) — no access to the original
+    # artifact required, so v1-origin entries serve too.
+    paths: list[str] = field(default_factory=list)
+    # per-chunk wire digests, filled lazily by the first outbound peer
+    # stream (build_wire_meta). Chunks are immutable for the entry's
+    # lifetime, so a warm node fanning a model out to N peers hashes the
+    # bytes once instead of N times.
+    wire_hashes: list[str] | None = None
 
 
 class HostRamTier:
@@ -76,10 +87,43 @@ class HostRamTier:
         self.metrics = metrics
         self.lru = make_lru_cache(int(capacity_bytes), self._on_evict)
         self._closed = threading.Event()
+        # outbound-stream pins (peer serving, ISSUE 8 satellite 1): the
+        # generic LRU engine cannot veto an eviction, so a pinned entry
+        # that gets evicted mid-stream is stashed here until the last pin
+        # releases — the in-flight sender keeps a consistent snapshot and
+        # LRU policy proceeds untouched.
+        self._pin_lock = threading.Lock()
+        self._pins: dict[ModelId, int] = {}
+        self._pinned_evicted: dict[ModelId, PackedModelEntry] = {}
 
     # -- LRU facade ---------------------------------------------------------
     def get(self, model_id: ModelId, touch: bool = True) -> PackedModelEntry | None:
         return self.lru.get(model_id, touch=touch)
+
+    # -- outbound-stream pinning -------------------------------------------
+    def pin(self, model_id: ModelId) -> PackedModelEntry | None:
+        """Acquire the entry for an outbound peer stream WITHOUT touching
+        LRU order (a remote read must not look like local demand). The
+        returned entry stays valid until the matching :meth:`unpin` even if
+        the tier evicts it meanwhile. None if absent (clean miss)."""
+        with self._pin_lock:
+            entry = self.lru.get(model_id, touch=False)
+            if entry is None:
+                entry = self._pinned_evicted.get(model_id)
+            if entry is None:
+                return None
+            self._pins[model_id] = self._pins.get(model_id, 0) + 1
+            return entry
+
+    def unpin(self, model_id: ModelId) -> None:
+        with self._pin_lock:
+            n = self._pins.get(model_id, 0) - 1
+            if n > 0:
+                self._pins[model_id] = n
+                return
+            self._pins.pop(model_id, None)
+            self._pinned_evicted.pop(model_id, None)
+        self._update_gauge()
 
     def put(self, model_id: ModelId, entry: PackedModelEntry) -> list[ModelId]:
         if self._closed.is_set():
@@ -119,7 +163,13 @@ class HostRamTier:
 
     # -- internals ----------------------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[PackedModelEntry]) -> None:
-        # dropping the references IS the free: chunks are plain host arrays
+        # dropping the references IS the free: chunks are plain host arrays.
+        # Unless an outbound stream holds a pin — then the payload parks in
+        # _pinned_evicted (bytes stay accounted via _update_gauge) and is
+        # actually freed by the last unpin.
+        with self._pin_lock:
+            if self._pins.get(model_id, 0) > 0 and entry.payload is not None:
+                self._pinned_evicted[model_id] = entry.payload
         if self.metrics is not None:
             self.metrics.evictions.labels("host").inc()
         self._update_gauge()
@@ -128,11 +178,12 @@ class HostRamTier:
         )
 
     def _update_gauge(self) -> None:
-        peak = RECORDER.observe_watermark(
-            "host_tier_bytes", float(self.lru.total_bytes)
-        )
+        with self._pin_lock:
+            pinned = sum(e.nbytes for e in self._pinned_evicted.values())
+        total = self.lru.total_bytes + pinned
+        peak = RECORDER.observe_watermark("host_tier_bytes", float(total))
         if self.metrics is not None:
-            self.metrics.host_tier_bytes.set(self.lru.total_bytes)
+            self.metrics.host_tier_bytes.set(total)
             self.metrics.host_tier_bytes_peak.set(peak)
 
     def clear(self) -> None:

@@ -141,10 +141,26 @@ class CacheManager:
                     source = src if src in ("hbm", "host") else "disk"
             else:
                 hit = False
-                source = "store"
                 model = self._with_deadline(
                     lambda: self._fetch(model_id), deadline, f"fetch {model_id}"
                 )
+                # a PeerProvider stamps where the bytes actually came from:
+                # "peer" = streamed from a warm node's host tier instead of
+                # the store (cache/providers/peer.py)
+                source = model.metadata.get("fetch_source", "store")
+                if source not in ("peer", "store"):
+                    source = "store"
+                # a peer fetch also hands over the transfer-ready packed
+                # chunks it assembled off the wire; the runtime promotes
+                # from those directly instead of re-reading the artifact it
+                # just wrote. POPPED unconditionally — a Model lives in the
+                # disk-cache map, and a retained entry would pin the packed
+                # bytes in RAM for as long as the artifact stays cached.
+                packed = model.metadata.pop("packed_entry", None)
+                if packed is not None:
+                    adopt = getattr(self.runtime, "adopt_packed_entry", None)
+                    if adopt is not None:
+                        adopt(model_id, packed)
                 self._with_deadline(
                     lambda: self.runtime.ensure_loaded(model), deadline,
                     f"load {model_id}",

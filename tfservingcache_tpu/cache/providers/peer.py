@@ -1,0 +1,253 @@
+"""PeerProvider: source cold misses from warm peers before the store.
+
+Fronts the configured provider (disk/s3/gcs/azblob) with the peer
+param-distribution path (ISSUE 8 tentpole): when the fleet status plane
+says another node holds the model at ``host``/``hbm`` residency, stream
+its ``PackedModelEntry`` over FetchPackedModel (protocol/peer_transfer.py)
+at cluster-internal wire speed instead of paying the object store's. Any
+peer-path problem — refused stream, mid-stream disconnect, integrity
+failure, timeout — logs loudly and falls back to the wrapped provider, so
+the worst case is exactly the pre-PR8 cold miss, never a failed request.
+
+Threading: CacheManager fetches run on worker threads, so this provider
+uses SYNC grpc channels (one cached per peer target, pruned with
+membership). The FleetView it consults lives on the router's event loop;
+its dict reads are GIL-safe snapshots and ``note_forward`` is a pure
+in-memory EWMA update — acceptable cross-thread by design (the same
+relaxation the status plane already makes for piggybacked trailers).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from tfservingcache_tpu.cache.providers.base import ModelProvider
+from tfservingcache_tpu.types import Model, ModelId, NodeInfo
+from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.tracing import TRACER
+
+log = get_logger("peer_provider")
+
+# fleet warmth tiers that make a peer a useful param source: host (2) means
+# packed chunks are sitting in its DRAM; hbm (3) implies host on nodes with
+# the tier enabled (inclusive downward)
+_MIN_WARMTH = 2
+
+
+class PeerProvider(ModelProvider):
+    """Decorator provider; constructed unbound (pass-through) by CacheNode
+    and bound to the fleet by the Router once discovery is up."""
+
+    def __init__(
+        self,
+        inner: ModelProvider,
+        chunk_bytes: int = 2 << 20,
+        timeout_s: float = 60.0,
+        max_message_bytes: int = 16 << 20,
+    ) -> None:
+        self.inner = inner
+        self.chunk_bytes = int(chunk_bytes)
+        self.timeout_s = float(timeout_s)
+        self.max_message_bytes = int(max_message_bytes)
+        self._fleet = None
+        self._cluster = None
+        self._self_idents: set[str] = set()
+        self._lock = threading.Lock()
+        self._channels: dict[str, object] = {}   # grpc target -> sync channel
+
+    # -- binding ------------------------------------------------------------
+    def bind_fleet(self, fleet, cluster, self_idents) -> None:
+        """Arm the peer path: ``fleet`` is the router's FleetView,
+        ``cluster`` the ClusterConnection (for member NodeInfo lookup),
+        ``self_idents`` this host's own ring identities (never fetch from
+        yourself). Until called, every fetch passes straight through."""
+        self._fleet = fleet
+        self._cluster = cluster
+        self._self_idents = set(self_idents)
+
+    def prune(self, nodes) -> None:
+        """Membership-change hook: drop channels to departed peers."""
+        live = {f"{n.host}:{n.grpc_port}" for n in nodes}
+        with self._lock:
+            for target in list(self._channels):
+                if target not in live:
+                    ch = self._channels.pop(target)
+                    try:
+                        ch.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    # -- peer path ----------------------------------------------------------
+    def _candidates(self, key: str) -> list[tuple[str, NodeInfo]]:
+        fleet, cluster = self._fleet, self._cluster
+        if fleet is None or cluster is None:
+            return []
+        scored: list[tuple[int, float, str, NodeInfo]] = []
+        for ident, node in cluster._nodes_by_ident.items():
+            if ident in self._self_idents:
+                continue
+            w = fleet.warmth(ident, key)
+            if w < _MIN_WARMTH:
+                continue
+            scored.append((w, fleet.health(ident), ident, node))
+        scored.sort(key=lambda t: (-t[0], -t[1]))
+        return [(ident, node) for _, _, ident, node in scored]
+
+    def _channel(self, node: NodeInfo):
+        import grpc
+
+        target = f"{node.host}:{node.grpc_port}"
+        with self._lock:
+            ch = self._channels.get(target)
+            if ch is None:
+                ch = grpc.insecure_channel(
+                    target,
+                    options=[
+                        ("grpc.max_receive_message_length", self.max_message_bytes),
+                        ("grpc.max_send_message_length", self.max_message_bytes),
+                        ("grpc.initial_reconnect_backoff_ms", 100),
+                        ("grpc.max_reconnect_backoff_ms", 5000),
+                    ],
+                )
+                self._channels[target] = ch
+            return ch
+
+    def _try_peers(self, name: str, version: int, dest_dir: str, on_file) -> Model | None:
+        """Attempt the peer path; None means fall back to the store."""
+        import grpc
+
+        from tfservingcache_tpu.cache.providers.base import atomic_dest
+        from tfservingcache_tpu.protocol.peer_transfer import (
+            PeerWireError,
+            fetch_from_peer,
+        )
+
+        mid = ModelId(name, version)
+        fleet = self._fleet
+        metrics = getattr(fleet, "metrics", None)
+        for ident, node in self._candidates(mid.key):
+            t0 = time.monotonic()
+            got = 0
+            entry_box: list = []
+            try:
+                with TRACER.span("peer_fetch", model=str(mid), peer=ident) as sp:
+                    with atomic_dest(dest_dir) as tmp:
+                        got = fetch_from_peer(
+                            self._channel(node), name, version, tmp,
+                            on_file=on_file, timeout_s=self.timeout_s,
+                            on_entry=entry_box.append,
+                        )
+                    sp.attrs["bytes"] = got
+                fleet.note_forward(ident, ok=True, latency_s=time.monotonic() - t0)
+                if metrics is not None:
+                    metrics.peer_fetch_bytes.labels("ok").inc(got)
+                log.info(
+                    "peer-sourced %s from %s: %d bytes in %.2fs",
+                    mid, ident, got, time.monotonic() - t0,
+                )
+                size = sum(
+                    os.path.getsize(os.path.join(r, f))
+                    for r, _d, fs in os.walk(dest_dir) for f in fs
+                )
+                model = Model(identifier=mid, path=dest_dir, size_on_disk=size)
+                model.metadata["fetch_source"] = "peer"
+                model.metadata["fetch_peer"] = ident
+                if entry_box:
+                    # transfer-ready packed chunks rebuilt off the wire:
+                    # CacheManager hands them to the runtime so the first
+                    # load promotes from RAM instead of re-reading the
+                    # artifact it just wrote
+                    model.metadata["packed_entry"] = entry_box[0]
+                return model
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                if code == grpc.StatusCode.NOT_FOUND:
+                    # clean miss: the peer's advertisement was stale (it
+                    # evicted since). The CONNECTION worked — that proves
+                    # liveness, so it counts as a forward success.
+                    fleet.note_forward(ident, ok=True,
+                                       latency_s=time.monotonic() - t0)
+                    if metrics is not None:
+                        metrics.peer_fetch_bytes.labels("not_found").inc(got)
+                    log.info("peer %s no longer holds %s; trying next source",
+                             ident, mid)
+                    continue
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    # the peer is alive but at its outbound cap — success
+                    # for health, try the next candidate
+                    fleet.note_forward(ident, ok=True,
+                                       latency_s=time.monotonic() - t0)
+                    if metrics is not None:
+                        metrics.peer_fetch_bytes.labels("error").inc(got)
+                    log.info("peer %s at stream cap for %s; trying next",
+                             ident, mid)
+                    continue
+                fleet.note_forward(ident, ok=False)
+                if metrics is not None:
+                    metrics.peer_fetch_bytes.labels("error").inc(got)
+                log.warning(
+                    "peer fetch of %s from %s FAILED mid-stream (%s: %s); "
+                    "falling back", mid, ident, code, e,
+                )
+                continue
+            except PeerWireError as e:
+                # bytes arrived but failed integrity — the peer is alive
+                # (connection-wise) but its stream is suspect; penalize
+                fleet.note_forward(ident, ok=False)
+                if metrics is not None:
+                    metrics.peer_fetch_bytes.labels("error").inc(got)
+                log.warning(
+                    "peer fetch of %s from %s failed integrity (%s); "
+                    "falling back", mid, ident, e,
+                )
+                continue
+            except Exception as e:  # noqa: BLE001 - peer path must not be fatal
+                fleet.note_forward(ident, ok=False)
+                if metrics is not None:
+                    metrics.peer_fetch_bytes.labels("error").inc(got)
+                log.warning(
+                    "peer fetch of %s from %s hit %s: %s; falling back",
+                    mid, ident, type(e).__name__, e,
+                )
+                continue
+        return None
+
+    # -- ModelProvider interface --------------------------------------------
+    def load_model(self, name: str, version: int, dest_dir: str) -> Model:
+        model = self._try_peers(name, version, dest_dir, on_file=None)
+        if model is not None:
+            return model
+        return self.inner.load_model(name, version, dest_dir)
+
+    def load_model_streaming(
+        self, name: str, version: int, dest_dir: str, on_file=None
+    ) -> Model:
+        model = self._try_peers(name, version, dest_dir, on_file=on_file)
+        if model is not None:
+            return model
+        return self.inner.load_model_streaming(
+            name, version, dest_dir, on_file=on_file
+        )
+
+    def model_size(self, name: str, version: int) -> int:
+        return self.inner.model_size(name, version)
+
+    def check(self) -> None:
+        self.inner.check()
+
+    def list_versions(self, name: str) -> list[int]:
+        return self.inner.list_versions(name)
+
+    def latest_version(self, name: str) -> int:
+        return self.inner.latest_version(name)
+
+    def close(self) -> None:
+        with self._lock:
+            for ch in self._channels.values():
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._channels.clear()

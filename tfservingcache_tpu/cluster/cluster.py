@@ -28,6 +28,12 @@ class ClusterConnection:
     ) -> None:
         self.discovery = discovery
         self.replicas_per_model = replicas_per_model
+        # per-key replica count override (cluster/replication.py): when set,
+        # find_nodes_for_key asks it for N instead of the static
+        # replicas_per_model. get_n's clockwise walk is prefix-stable in N
+        # (growing N appends members, the first k stay put), so a changing
+        # N never remaps existing replicas — only adds or drops the tail.
+        self.replicas_for_key: Callable[[str], int] | None = None
         self.ring = make_ring(vnodes=vnodes)  # C++ ring when built, Python fallback
         self._nodes_by_ident: dict[str, NodeInfo] = {}
         self._task: asyncio.Task | None = None
@@ -76,9 +82,17 @@ class ClusterConnection:
                     log.exception("cluster on_update callback failed")
 
     def find_nodes_for_key(self, key: str) -> list[NodeInfo]:
-        """The full replica set for a key (reference FindNodeForKey,
-        cluster.go:116-130)."""
-        idents = self.ring.get_n(key, self.replicas_per_model)
+        """The full replica set for a key. Reference FindNodeForKey
+        (cluster.go:116-130) with one deliberate divergence: the reference's
+        replicasPerModel is a static config constant; here N is per-key and
+        load-adaptive when a replica controller is wired in."""
+        n = self.replicas_per_model
+        if self.replicas_for_key is not None:
+            try:
+                n = max(1, int(self.replicas_for_key(key)))
+            except Exception:  # noqa: BLE001 - advisory hook, routing must not fail
+                n = self.replicas_per_model
+        idents = self.ring.get_n(key, n)
         return [self._nodes_by_ident[i] for i in idents if i in self._nodes_by_ident]
 
     def node_for_key(self, key: str) -> NodeInfo | None:

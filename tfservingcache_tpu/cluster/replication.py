@@ -1,0 +1,216 @@
+"""Load-adaptive per-model replication (ISSUE 8 tentpole, part 2).
+
+The reference pins ``replicasPerModel`` as a cluster-wide constant
+(cluster.go); DeepServe (PAPERS.md) shows replica counts must follow load.
+Here a ReplicaController watches the router's per-key in-flight counts and
+drives ``HashRing.get_n``'s N per model: hot models grow toward
+``cluster.max_replicas_per_model`` (each newly-assigned local group is
+proactively warmed — through the PeerProvider, so the params usually
+arrive over the cluster-internal peer path instead of the store), cold
+models decay back to the ``proxy.replicas_per_model`` floor.
+
+Ring stability comes in two layers. ``get_n``'s clockwise walk is
+prefix-stable in N — growing N appends members and the first k never
+move — so a changing N cannot remap traffic that an existing replica
+already serves. What still needs damping is N itself: the controller
+grows immediately (underprovisioning is user-visible latency) but shrinks
+only after ``replica_decay_ticks`` consecutive evaluations wanting a
+lower N (hysteresis — an oscillating load near a threshold must not flap
+the tail replica's assignment).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import threading
+from typing import Mapping
+
+from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("replication")
+
+# EWMA weight for the per-key demand signal sampled at each evaluation
+_DEMAND_ALPHA = 0.5
+# a key whose smoothed demand sits below this (and whose target is back at
+# the floor) is forgotten entirely — bounds both dict and gauge cardinality
+_IDLE_EPSILON = 0.05
+
+
+class _KeyState:
+    __slots__ = ("ewma", "target", "decay", "inflight", "peak")
+
+    def __init__(self, base: int) -> None:
+        self.ewma = 0.0
+        self.target = base
+        self.decay = 0
+        self.inflight = 0
+        self.peak = 0
+
+
+class ReplicaController:
+    """Per-model replica target driven by routed in-flight load.
+
+    Plugged into ``ClusterConnection.replicas_for_key`` (read side) and
+    fed by ``RoutingBackend`` ``note_start``/``note_end`` around every
+    forwarded or short-circuited request (write side). ``evaluate()`` is
+    one synchronous tick — the periodic task calls it, and tests drive it
+    directly for determinism."""
+
+    def __init__(
+        self,
+        cluster,
+        base_replicas: int = 1,
+        max_replicas: int = 4,
+        load_target: float = 2.0,
+        decay_ticks: int = 3,
+        interval_s: float = 2.0,
+        metrics=None,
+        local_managers: Mapping[str, object] | None = None,
+    ) -> None:
+        self.cluster = cluster
+        self.base = max(1, int(base_replicas))
+        self.max = max(self.base, int(max_replicas))
+        self.load_target = max(1e-6, float(load_target))
+        self.decay_ticks = max(1, int(decay_ticks))
+        self.interval_s = float(interval_s)
+        self.metrics = metrics
+        # ring ident -> CacheManager for the chip groups in THIS process:
+        # growth warms newly-assigned local groups proactively
+        self.local_managers = dict(local_managers or {})
+        self._lock = threading.Lock()
+        self._keys: dict[str, _KeyState] = {}
+        self._warming: set[tuple[str, str]] = set()   # (key, ident) in flight
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    # -- demand notes (router event loop; lock kept for the warm threads) ---
+    def note_start(self, key: str) -> None:
+        with self._lock:
+            st = self._keys.get(key)
+            if st is None:
+                st = self._keys[key] = _KeyState(self.base)
+            st.inflight += 1
+            if st.inflight > st.peak:
+                st.peak = st.inflight
+
+    def note_end(self, key: str) -> None:
+        with self._lock:
+            st = self._keys.get(key)
+            if st is not None and st.inflight > 0:
+                st.inflight -= 1
+
+    # -- read side (ClusterConnection.replicas_for_key) ---------------------
+    def replicas_for(self, key: str) -> int:
+        st = self._keys.get(key)  # GIL-safe read; no lock on the hot path
+        return st.target if st is not None else self.base
+
+    # -- control loop -------------------------------------------------------
+    def evaluate(self) -> dict[str, int]:
+        """One tick: smooth demand, recompute targets with hysteresis,
+        publish gauges, trigger proactive warming, prune idle keys.
+        Returns the surviving {key: target} map (tests assert on it)."""
+        grown: list[tuple[str, int, int]] = []
+        with self._lock:
+            for key, st in list(self._keys.items()):
+                demand = max(st.inflight, st.peak)
+                st.peak = st.inflight
+                st.ewma = _DEMAND_ALPHA * demand + (1 - _DEMAND_ALPHA) * st.ewma
+                desired = max(
+                    self.base,
+                    min(self.max, math.ceil(st.ewma / self.load_target)),
+                )
+                if desired > st.target:
+                    grown.append((key, st.target, desired))
+                    st.target = desired
+                    st.decay = 0
+                elif desired < st.target:
+                    st.decay += 1
+                    if st.decay >= self.decay_ticks:
+                        st.target = desired
+                        st.decay = 0
+                else:
+                    st.decay = 0
+                if (
+                    st.target <= self.base
+                    and st.inflight == 0
+                    and st.ewma < _IDLE_EPSILON
+                ):
+                    del self._keys[key]
+                    self._remove_gauge(key)
+                else:
+                    self._publish(key, st.target)
+            result = {k: s.target for k, s in self._keys.items()}
+        for key, old_n, new_n in grown:
+            log.info("replica target for %s: %d -> %d", key, old_n, new_n)
+            self._warm_new_replicas(key, old_n, new_n)
+        return result
+
+    def _publish(self, key: str, target: int) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.model_replicas_target.labels(key).set(target)
+            except Exception:  # noqa: BLE001 - observability must not bite
+                pass
+
+    def _remove_gauge(self, key: str) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.model_replicas_target.remove(key)
+            except Exception:  # noqa: BLE001 - series may never have existed
+                pass
+
+    def _warm_new_replicas(self, key: str, old_n: int, new_n: int) -> None:
+        """Pre-load the key on newly-assigned LOCAL groups. get_n's prefix
+        stability means exactly idents[old_n:new_n] are new; remote new
+        replicas warm themselves the same way when their own controller
+        grows (every router runs one over the same routed traffic)."""
+        try:
+            idents = self.cluster.ring.get_n(key, new_n)
+        except Exception:  # noqa: BLE001 - empty ring etc.
+            return
+        name, _, version = key.rpartition("##")
+        if not name:
+            return
+        mid = ModelId(name, int(version))
+        for ident in idents[old_n:]:
+            manager = self.local_managers.get(ident)
+            if manager is None:
+                continue
+            token = (key, ident)
+            with self._lock:
+                if token in self._warming:
+                    continue
+                self._warming.add(token)
+
+            def work(manager=manager, mid=mid, token=token) -> None:
+                try:
+                    manager.ensure_servable(mid)
+                    log.info("proactively warmed %s on %s", mid, token[1])
+                except Exception as e:  # noqa: BLE001 - advisory warm
+                    log.warning("proactive warm of %s failed: %s", mid, e)
+                finally:
+                    with self._lock:
+                        self._warming.discard(token)
+
+            threading.Thread(
+                target=work, daemon=True, name="tpusc-replica-warm"
+            ).start()
+
+    async def run(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await asyncio.to_thread(self.evaluate)
+            except Exception:  # noqa: BLE001 - controller must survive a tick
+                log.exception("replica evaluation failed")
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

@@ -145,6 +145,10 @@ class RoutingBackend(ServingBackend):
         # deleted at zero so departed peers don't accumulate ghost keys.
         self._inflight: dict[str, int] = {}
         self._http: aiohttp.ClientSession | None = None
+        # load-adaptive replication (cluster/replication.py): when attached,
+        # every routed request's start/end feeds the per-KEY demand signal
+        # the ReplicaController turns into ring replica counts
+        self.demand = None
         cluster.on_update.append(self.pool.prune)
 
     def _resolve_label(self, name: str, label: str) -> int:
@@ -262,6 +266,16 @@ class RoutingBackend(ServingBackend):
         return self.fleet.health(ident)
 
     async def _forward_grpc(self, service: str, method: str, name: str, version, request):
+        if self.demand is None:
+            return await self._forward_grpc_inner(service, method, name, version, request)
+        key = ModelId(name, int(version or 0)).key
+        self.demand.note_start(key)
+        try:
+            return await self._forward_grpc_inner(service, method, name, version, request)
+        finally:
+            self.demand.note_end(key)
+
+    async def _forward_grpc_inner(self, service: str, method: str, name: str, version, request):
         last_err: Exception | None = None
         for attempt, node in enumerate(self._candidates(name, version)[: self.retries + 1]):
             local = self.local_backends.get(node.ident)
@@ -413,6 +427,23 @@ class RoutingBackend(ServingBackend):
         if label is not None:
             # resolve before ring lookup; forward the concrete version
             version = self._resolve_label(model_name, label)
+        if self.demand is None:
+            return await self._handle_rest_inner(method, model_name, version, verb, body)
+        key = ModelId(model_name, int(version or 0)).key
+        self.demand.note_start(key)
+        try:
+            return await self._handle_rest_inner(method, model_name, version, verb, body)
+        finally:
+            self.demand.note_end(key)
+
+    async def _handle_rest_inner(
+        self,
+        method: str,
+        model_name: str,
+        version: int | None,
+        verb: str | None,
+        body: bytes,
+    ) -> RestResponse:
         last_err: Exception | None = None
         for node in self._candidates(model_name, version)[: self.retries + 1]:
             local = self.local_backends.get(node.ident)
@@ -552,6 +583,38 @@ class Router:
             local_warmth=local_warmth,
             fleet=self.fleet,
         )
+        # load-adaptive replication: routed demand -> per-model ring N
+        # (cluster/replication.py); 0 disables (static replicas_per_model)
+        self.replicas = None
+        if cfg.cluster.max_replicas_per_model > 0:
+            from tfservingcache_tpu.cluster.replication import ReplicaController
+
+            self.replicas = ReplicaController(
+                self.cluster,
+                base_replicas=cfg.proxy.replicas_per_model,
+                max_replicas=cfg.cluster.max_replicas_per_model,
+                load_target=cfg.cluster.replica_load_target,
+                decay_ticks=cfg.cluster.replica_decay_ticks,
+                interval_s=cfg.cluster.replica_eval_interval_s,
+                metrics=metrics,
+                local_managers=(
+                    {n.ident: g.manager
+                     for n, g in zip(self.self_nodes, node.groups)}
+                    if node is not None else {}
+                ),
+            )
+            self.cluster.replicas_for_key = self.replicas.replicas_for
+            self.backend.demand = self.replicas
+        # arm the node's PeerProvider (cache/providers/peer.py): the fleet's
+        # warmth map + cluster membership turn cold misses into peer streams
+        if node is not None and self.fleet is not None:
+            provider = getattr(node.manager, "provider", None)
+            if provider is not None and hasattr(provider, "bind_fleet"):
+                provider.bind_fleet(
+                    self.fleet, self.cluster,
+                    {n.ident for n in self.self_nodes},
+                )
+                self.cluster.on_update.append(provider.prune)
         self.rest = RestServingServer(
             self.backend, metrics, require_version=True, metrics_path=cfg.metrics.path
         )
@@ -584,6 +647,8 @@ class Router:
         grpc_port = await self.grpc.start(self.cfg.proxy.grpc_port)
         if self.status_exchange is not None:
             self.status_exchange.start()
+        if self.replicas is not None:
+            self.replicas.start()
         self._health_task = asyncio.create_task(self._health_loop())
         log.info(
             "router up: REST :%d gRPC :%d as %s (%d ring members)",
@@ -600,6 +665,8 @@ class Router:
     async def close(self) -> None:
         if self._health_task is not None:
             self._health_task.cancel()
+        if self.replicas is not None:
+            self.replicas.close()
         if self.warmer is not None:
             # blocking join: keep the event loop free for the teardown below
             await asyncio.to_thread(self.warmer.close)

@@ -213,6 +213,34 @@ class ClusterConfig:
     health_error_alpha: float = 0.3
     # latency normalization: score factor = ref / (ref + latency_ewma)
     health_latency_ref_s: float = 1.0
+    # -- peer param distribution (cache/providers/peer.py) ------------------
+    # On a cold miss, stream another node's host-tier packed chunks over
+    # gRPC instead of refetching from the provider (requires
+    # status_exchange for the warmth map). Off: every miss goes to store.
+    peer_fetch: bool = True
+    # target size of one streamed chunk message (the sender re-frames the
+    # ~256 MB pack-plan chunks into messages of at most this many bytes)
+    peer_fetch_chunk_bytes: int = 2 << 20
+    # outbound streams a single node serves per requesting peer at once;
+    # excess fetches are refused (the asker falls back to the store)
+    peer_fetch_max_inflight_per_peer: int = 2
+    # end-to-end deadline for one peer fetch; on expiry the asker falls
+    # back to the store (loud, never request-fatal)
+    peer_fetch_timeout_s: float = 60.0
+    # -- load-adaptive replication (cluster/replication.py) -----------------
+    # ceiling for the per-model replica count the controller may grow to;
+    # proxy.replicas_per_model stays the floor/default. 0 disables the
+    # controller (static N, pre-PR8 behavior).
+    max_replicas_per_model: int = 4
+    # in-flight requests per replica (EWMA) that justify one more replica:
+    # desired N = clamp(ceil(ewma / target), base, max)
+    replica_load_target: float = 2.0
+    # controller evaluation cadence
+    replica_eval_interval_s: float = 2.0
+    # shrink hysteresis: N decays only after this many CONSECUTIVE evals
+    # wanting a lower N (growth applies immediately; ring assignment is
+    # prefix-stable under N changes so only N itself needs damping)
+    replica_decay_ticks: int = 3
 
 
 @dataclass

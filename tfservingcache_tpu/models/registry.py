@@ -422,11 +422,27 @@ def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
     manifest = spec.get("manifest")
     if manifest is None or not os.path.exists(bin_path):
         raise ArtifactError(f"artifact missing params manifest or {bin_path}")
+    # ONE sequential read; every leaf is a zero-copy aligned view into it
+    blob = np.fromfile(bin_path, dtype=np.uint8)
+    return model, params_from_manifest(meta, blob, raw_quant=raw_quant,
+                                       src=bin_path)
+
+
+def params_from_manifest(meta: dict[str, Any], blob: np.ndarray,
+                         raw_quant: bool = False,
+                         src: str = "params blob") -> Any:
+    """Rebuild the params pytree from a v2 ``model.json`` dict plus the
+    raw ``params.bin`` bytes as a uint8 array — the manifest walk of
+    ``load_artifact`` without the filesystem. Peer param distribution
+    (protocol/peer_transfer.py) feeds this the byte image it assembled in
+    RAM off the wire, so the receiver's packed entry never waits on a
+    disk round-trip. Leaves are zero-copy views into ``blob``."""
+    manifest = (meta.get("params") or {}).get("manifest")
+    if manifest is None:
+        raise ArtifactError(f"missing params manifest for {src}")
     import ml_dtypes  # registers bfloat16/float8 names with np.dtype
 
     del ml_dtypes
-    # ONE sequential read; every leaf is a zero-copy aligned view into it
-    blob = np.fromfile(bin_path, dtype=np.uint8)
     nested: dict[str, Any] = {}
     for ent in manifest:
         dt = np.dtype(ent["dtype"])
@@ -434,7 +450,7 @@ def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
         off, nbytes = int(ent["offset"]), int(ent["nbytes"])
         if nbytes != n * dt.itemsize or off + nbytes > blob.nbytes:
             raise ArtifactError(
-                f"corrupt manifest entry {ent['path']!r} in {bin_path}"
+                f"corrupt manifest entry {ent['path']!r} in {src}"
             )
         arr = np.frombuffer(blob.data, dtype=dt, count=n, offset=off).reshape(
             ent["shape"]
@@ -446,7 +462,7 @@ def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
             soff, snb = int(quant["scale_offset"]), int(quant["scale_nbytes"])
             if snb != sn * sdt.itemsize or soff + snb > blob.nbytes:
                 raise ArtifactError(
-                    f"corrupt quant scales for {ent['path']!r} in {bin_path}"
+                    f"corrupt quant scales for {ent['path']!r} in {src}"
                 )
             scale = np.frombuffer(
                 blob.data, dtype=sdt, count=sn, offset=soff
@@ -454,13 +470,13 @@ def load_artifact(path: str, raw_quant: bool = False) -> tuple[ModelDef, Any]:
             ql = QuantLeaf(arr, scale, quant["orig_dtype"])
             arr = ql if raw_quant else ql.dequant_host()
         if ent["path"] == "":
-            return model, arr  # params was a single bare array
+            return arr  # params was a single bare array
         node = nested
         parts = ent["path"].split("/")
         for part in parts[:-1]:
             node = node.setdefault(part, {})
         node[parts[-1]] = arr
-    return model, _restore_lists(nested)
+    return _restore_lists(nested)
 
 
 def load_artifact_meta(path: str) -> dict[str, Any]:

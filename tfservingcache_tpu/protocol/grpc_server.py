@@ -120,6 +120,10 @@ class GrpcServingServer:
         # by CacheNode: answers the tpusc-status-want metadata marker with a
         # tpusc-status trailer on routed hops
         self.status_collector = None
+        # peer param distribution (protocol/peer_transfer.py PeerSource),
+        # attached post-construction by CacheNode: serves this node's
+        # host-tier packed entries to cold peers over FetchPackedModel
+        self.peer_source = None
 
     # -- handler plumbing ---------------------------------------------------
     def _unary(self, fn, req_cls, resp_cls):
@@ -204,6 +208,58 @@ class GrpcServingServer:
         # (tfservingproxy.go:215-217).
         raise BackendError("MultiInference not supported", grpc.StatusCode.UNIMPLEMENTED, 501)
 
+    async def _fetch_packed_model(self, request: bytes, context: grpc.aio.ServicerContext):
+        """tpusc.internal.PeerTransfer/FetchPackedModel: stream this node's
+        host-tier packed entry to a cold peer (protocol/peer_transfer.py).
+        NOT_FOUND when the model isn't in the host tier (the asker treats
+        that as a clean miss — the fleet warmth map can lag an eviction by
+        up to status_stale_after_s); RESOURCE_EXHAUSTED over the per-peer
+        in-flight cap. The entry stays pinned for the stream's duration so
+        a concurrent eviction can't tear the bytes mid-flight."""
+        from tfservingcache_tpu.protocol.peer_transfer import (
+            PeerWireError,
+            decode_request,
+            iter_frames,
+        )
+        from tfservingcache_tpu.types import ModelId
+
+        src = self.peer_source
+        if src is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "peer transfer not enabled"
+            )
+        try:
+            name, version = decode_request(request)
+        except PeerWireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        mid = ModelId(name, version)
+        # per-requesting-HOST cap: the connection's ephemeral port would
+        # make every stream its own "peer"
+        peer = context.peer() or "?"
+        peer_key = peer.rsplit(":", 1)[0]
+        if not src.acquire(peer_key):
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"peer fetch in-flight cap ({src.max_inflight_per_peer}) reached",
+            )
+        entry = None
+        try:
+            entry = src.pin(mid)
+            if entry is None:
+                await context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"{mid} not in host tier"
+                )
+            with TRACER.span("peer_stream_out", model=str(mid), peer=peer_key):
+                for frame in iter_frames(entry, src.chunk_bytes, model_id=mid):
+                    yield frame
+        except PeerWireError as e:
+            log.warning("peer stream of %s failed: %s", mid, e)
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            if entry is not None:
+                src.unpin(mid)
+            src.release(peer_key)
+
     def _handlers(self) -> list[grpc.GenericRpcHandler]:
         b = self.backend
         impl = {
@@ -229,6 +285,23 @@ class GrpcServingServer:
             while True:
                 yield health_pb.HealthCheckResponse(status=self.health.status)
                 await self.health.wait_change()
+
+        # peer param distribution: raw-bytes server streaming (see
+        # protocol/peer_transfer.py for the frame format); registered
+        # before the catch-all so it is claimed like any known service
+        if self.peer_source is not None:
+            from tfservingcache_tpu.protocol.peer_transfer import (
+                PEER_FETCH_METHOD,
+                PEER_TRANSFER_SERVICE,
+            )
+
+            per_service[PEER_TRANSFER_SERVICE] = {
+                PEER_FETCH_METHOD: grpc.unary_stream_rpc_method_handler(
+                    self._fetch_packed_model,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                ),
+            }
 
         per_service[HEALTH_SERVICE] = {
             "Check": grpc.unary_unary_rpc_method_handler(

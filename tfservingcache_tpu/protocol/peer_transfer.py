@@ -1,0 +1,531 @@
+"""Peer param-distribution wire format (ISSUE 8 tentpole).
+
+On a cold miss, a node that sees a peer advertising ``hbm``/``host``
+residency for the model (fleet status plane, cluster/status.py) streams
+that peer's host-tier ``PackedModelEntry`` over a gRPC server-streaming
+method instead of refetching from the provider — the packed chunks ARE the
+raw leaf bytes the artifact stores, so the receiver can land a complete,
+byte-exact ``tpusc.v2`` artifact on its own disk and feed it to the normal
+pipelined load path unchanged. λScale (PAPERS.md) calls this
+cluster-internal multicast of model state the key serverless-LLM scale-up
+lever; here it rides the existing tiers.
+
+No protoc/grpc_tools in the image (see grpc_server.py), so the stream uses
+raw-bytes identity serializers with a one-byte frame tag:
+
+    request  = JSON {"name": ..., "version": ...}
+    frame M  = b"M" + JSON wire meta: the complete synthesized model.json
+               (manifest offsets included) plus per-pack-chunk hashes and
+               the chunk->file segment map
+    frame C  = b"C" + <u32 chunk_idx> <u64 offset_in_chunk> + payload
+               (payload <= cluster.peer_fetch_chunk_bytes, in-order per
+               chunk — gRPC streams preserve ordering)
+    frame E  = b"E" + JSON {"chunks": n, "wire_bytes": total}
+
+The M frame goes FIRST so the receiver writes ``model.json`` immediately
+and the manager's ``on_file`` hook fires ``precompile_from_meta`` — the
+same fetch∥compile overlap the store path gets (cache/manager.py _fetch).
+
+The sender synthesizes the model.json purely from the entry:
+``PackedModelEntry.paths`` maps outer leaves to artifact paths, dtypes
+come from the chunk buffers, and quant leaves re-emit the save_artifact
+``quant`` sub-entry — so even an entry whose origin artifact was v1 (or
+whose disk copy is gone) serves a valid v2 artifact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import struct
+import threading
+from typing import Any, Iterator
+
+import numpy as np
+
+from tfservingcache_tpu.models.registry import (
+    ARTIFACT_FORMAT,
+    MODEL_JSON,
+    PARAMS_BIN,
+    _ALIGN,
+)
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("peer_transfer")
+
+PEER_TRANSFER_SERVICE = "tpusc.internal.PeerTransfer"
+PEER_FETCH_METHOD = "FetchPackedModel"
+PEER_FETCH_PATH = f"/{PEER_TRANSFER_SERVICE}/{PEER_FETCH_METHOD}"
+
+FRAME_META = 0x4D    # "M"
+FRAME_CHUNK = 0x43   # "C"
+FRAME_END = 0x45     # "E"
+_CHUNK_HDR = struct.Struct("<IQ")
+
+
+class PeerWireError(Exception):
+    """Malformed or integrity-failing peer stream (receiver side: always
+    degrades to the store path, never request-fatal)."""
+
+
+def encode_request(name: str, version: int) -> bytes:
+    return json.dumps({"name": name, "version": int(version)}).encode()
+
+
+def decode_request(data: bytes) -> tuple[str, int]:
+    try:
+        req = json.loads(data.decode())
+        return str(req["name"]), int(req["version"])
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        raise PeerWireError(f"bad FetchPackedModel request: {e}") from e
+
+
+def _chunk_hash(buf: np.ndarray) -> str:
+    # uint8 view, not tobytes(): extension dtypes (bfloat16) lack the
+    # buffer protocol, and a view avoids copying a ~256 MB chunk to hash it.
+    # sha256 truncated to 128 bits, not blake2b: SHA-NI makes sha256 ~2x
+    # faster per byte on current x86, and the receiver hashes every wire
+    # byte on the cold-start critical path
+    return hashlib.sha256(
+        memoryview(buf.reshape(-1).view(np.uint8))
+    ).hexdigest()[:32]
+
+
+def build_wire_meta(entry: Any, model_id: Any = None) -> dict[str, Any]:
+    """Synthesize the M-frame payload from a ``PackedModelEntry``.
+
+    Computes a fresh set of 16-byte-aligned ``params.bin`` offsets in pack
+    order (offsets need only be self-consistent, not identical to the
+    origin artifact's) and re-derives the save_artifact manifest schema
+    from the entry's owner/shapes/paths/quant bookkeeping.
+    """
+    md = entry.model_def
+    n_outer = len(entry.paths)
+    if n_outer == 0 or any(oi >= n_outer for oi, _ in entry.owner):
+        raise PeerWireError(
+            "entry has no leaf-path map (pre-PR8 build?); cannot serve"
+        )
+
+    # pack chunks are immutable while the entry is pinned, so their digests
+    # are a per-entry constant — cache them on the entry after the first
+    # stream. Hashing is the sender's single largest per-byte cost; a warm
+    # node serving the same model to N peers should pay it once, not N times.
+    cached = getattr(entry, "wire_hashes", None)
+    use_cache = isinstance(cached, list) and len(cached) == len(entry.chunks)
+    fresh_hashes: list[str] = []
+
+    # flat idx -> (file offset, nbytes, dtype name) + per-chunk segment map
+    flat_file: dict[int, tuple[int, int, str]] = {}
+    segments: list[list[tuple[int, int, int]]] = []  # per chunk: (chunk_off, file_off, nbytes)
+    chunk_meta: list[dict[str, Any]] = []
+    offset = 0
+    for ci, (plan, buf) in enumerate(entry.chunks):
+        dt = buf.dtype
+        segs: list[tuple[int, int, int]] = []
+        chunk_off = 0
+        for i in plan:
+            shape = entry.shapes[i]
+            n = int(np.prod(shape)) if shape else 1
+            nb = n * dt.itemsize
+            offset += (-offset) % _ALIGN
+            flat_file[i] = (offset, nb, dt.name)
+            segs.append((chunk_off, offset, nb))
+            offset += nb
+            chunk_off += nb
+        if chunk_off != buf.nbytes:
+            raise PeerWireError(
+                f"entry chunk byte mismatch: plan says {chunk_off}, "
+                f"buffer holds {buf.nbytes}"
+            )
+        segments.append(segs)
+        h = cached[ci] if use_cache else _chunk_hash(buf)
+        fresh_hashes.append(h)
+        chunk_meta.append({"nbytes": buf.nbytes, "hash": h})
+
+    if not use_cache:
+        # whole-list assignment, not append-as-we-go: concurrent first
+        # streams each build a complete list and the last store wins intact
+        try:
+            entry.wire_hashes = fresh_hashes
+        except Exception:
+            pass  # exotic entry type without settable attrs: just recompute
+
+    # outer idx -> role -> flat idx (QuantLeaf contributes q + scale)
+    roles: dict[int, dict[str, int]] = {}
+    for i, (oi, role) in enumerate(entry.owner):
+        roles.setdefault(oi, {})[role] = i
+    manifest: list[dict[str, Any]] = []
+    for oi in sorted(roles, key=lambda o: min(roles[o].values())):
+        got = roles[oi]
+        path = entry.paths[oi]
+        if "plain" in got:
+            i = got["plain"]
+            off, nb, dtname = flat_file[i]
+            manifest.append({
+                "path": path, "dtype": dtname,
+                "shape": list(entry.shapes[i]), "offset": off, "nbytes": nb,
+            })
+        else:
+            qi, si = got["q"], got["scale"]
+            qoff, qnb, _ = flat_file[qi]
+            soff, snb, sdt = flat_file[si]
+            manifest.append({
+                "path": path, "dtype": "int8",
+                "shape": list(entry.shapes[qi]), "offset": qoff, "nbytes": qnb,
+                "quant": {
+                    "orig_dtype": entry.quant_dtypes[oi],
+                    "scale_dtype": sdt,
+                    "scale_shape": list(entry.shapes[si]),
+                    "scale_offset": soff,
+                    "scale_nbytes": snb,
+                },
+            })
+
+    model_json = {
+        "format": ARTIFACT_FORMAT,
+        "family": md.family,
+        "config": md.config,
+        "param_dtype": md.store_param_dtype,
+        "quantize": "int8" if entry.quant_dtypes else None,
+        "params": {"file": PARAMS_BIN, "manifest": manifest},
+        "signature": {
+            "inputs": {k: [v.dtype, list(v.shape)] for k, v in md.input_spec.items()},
+            "outputs": {k: [v.dtype, list(v.shape)] for k, v in md.output_spec.items()},
+            "method_name": md.method_name,
+        },
+    }
+    return {
+        "model": str(model_id) if model_id is not None else "",
+        "model_json": model_json,
+        "segments": segments,
+        "chunks": chunk_meta,
+        "file_bytes": offset,
+        "wire_bytes": sum(c["nbytes"] for c in chunk_meta),
+    }
+
+
+def iter_frames(entry: Any, chunk_msg_bytes: int,
+                model_id: Any = None) -> Iterator[bytes]:
+    """Sender: M frame, then the pack chunks carved into <=chunk_msg_bytes
+    messages, then the E frame. Snapshot-consistent as long as the caller
+    holds a host-tier pin for the duration."""
+    meta = build_wire_meta(entry, model_id)
+    yield bytes([FRAME_META]) + json.dumps(meta).encode()
+    step = max(int(chunk_msg_bytes), 64 << 10)
+    for ci, (_plan, buf) in enumerate(entry.chunks):
+        mv = memoryview(buf.reshape(-1).view(np.uint8))
+        for off in range(0, len(mv), step):
+            # join over a memoryview slice: one copy into the outgoing
+            # frame instead of slice-to-bytes plus concatenate
+            head = bytes([FRAME_CHUNK]) + _CHUNK_HDR.pack(ci, off)
+            yield b"".join((head, mv[off:off + step]))
+    yield bytes([FRAME_END]) + json.dumps(
+        {"chunks": len(entry.chunks), "wire_bytes": meta["wire_bytes"]}
+    ).encode()
+
+
+class PeerStreamReceiver:
+    """Receiver: assembles a stream of frames into a complete v2 artifact
+    at ``dest_dir`` (the caller stages via ``atomic_dest``), verifying
+    per-chunk length and hash as bytes land. ``feed`` returns "meta" when
+    model.json has been written (fire ``on_file`` then), "chunk" for data
+    frames, "end" when the stream completed clean."""
+
+    def __init__(self, dest_dir: str, assemble: bool = False) -> None:
+        self.dest_dir = dest_dir
+        self.meta: dict[str, Any] | None = None
+        self.meta_path = os.path.join(dest_dir, MODEL_JSON)
+        self.bytes_received = 0
+        # assemble=True additionally scatters the payload into a RAM image
+        # of params.bin (``self.image``), so the caller can rebuild the
+        # packed entry the moment the stream ends — the artifact lands on
+        # disk for the inclusive-tier invariant, but the first load never
+        # waits on reading it back
+        self.assemble = assemble
+        self.image: np.ndarray | None = None
+        self._fh = None
+        self._expect: list[int] = []        # per chunk: next expected offset
+        self._seg_ptr: list[int] = []       # per chunk: current segment index
+        self._hashers: list[Any] = []
+        self._done: list[bool] = []
+        # write-behind: params.bin persistence runs on a side thread so the
+        # stream consumer (hash + scatter bookkeeping) never stalls on disk
+        # — durability is not on the serving-critical path, and the end
+        # frame joins the writer before reporting the stream complete. The
+        # bounded queue caps buffered bytes at ~queue_len * frame size.
+        self._wq: "queue.Queue | None" = None
+        self._writer: threading.Thread | None = None
+        self._werr: list[Exception] = []
+
+    def feed(self, frame: bytes) -> str:
+        if not frame:
+            raise PeerWireError("empty frame")
+        kind = frame[0]
+        if kind == FRAME_META:
+            return self._on_meta(frame[1:])
+        if kind == FRAME_CHUNK:
+            return self._on_chunk(frame[1:])
+        if kind == FRAME_END:
+            return self._on_end(frame[1:])
+        raise PeerWireError(f"unknown frame tag 0x{kind:02x}")
+
+    def _on_meta(self, body: bytes) -> str:
+        if self.meta is not None:
+            raise PeerWireError("duplicate meta frame")
+        try:
+            self.meta = json.loads(body.decode())
+            model_json = self.meta["model_json"]
+            chunks = self.meta["chunks"]
+            self._segments = [
+                [(int(a), int(b), int(c)) for a, b, c in segs]
+                for segs in self.meta["segments"]
+            ]
+        except (ValueError, KeyError, TypeError) as e:
+            raise PeerWireError(f"bad meta frame: {e}") from e
+        if len(self._segments) != len(chunks):
+            raise PeerWireError("meta segment/chunk count mismatch")
+        os.makedirs(self.dest_dir, exist_ok=True)
+        # model.json first ON PURPOSE: inside the staging dir completeness
+        # is the atomic rename's job, and landing it now lets the on_file
+        # hook start the family compile while params are still in flight
+        with open(self.meta_path, "w") as f:
+            json.dump(model_json, f, indent=1)
+        self._fh = open(os.path.join(self.dest_dir, PARAMS_BIN), "wb")
+        self._fh.truncate(int(self.meta["file_bytes"]))
+        if self.assemble:
+            # zeros, not empty: alignment gaps stay deterministic, and
+            # calloc makes the 0-fill lazy anyway
+            self.image = np.zeros(int(self.meta["file_bytes"]), np.uint8)
+        self._wq = queue.Queue(maxsize=32)
+        self._writer = threading.Thread(
+            target=self._write_loop, name="tpusc-peer-rx-write", daemon=True
+        )
+        self._writer.start()
+        n = len(chunks)
+        self._expect = [0] * n
+        self._seg_ptr = [0] * n
+        self._hashers = [hashlib.sha256() for _ in range(n)]
+        self._done = [False] * n
+        return "meta"
+
+    def _on_chunk(self, body: bytes) -> str:
+        if self.meta is None or self._fh is None:
+            raise PeerWireError("chunk frame before meta")
+        if len(body) < _CHUNK_HDR.size:
+            raise PeerWireError("truncated chunk frame")
+        ci, off = _CHUNK_HDR.unpack_from(body)
+        # memoryview, not a bytes slice: hash/write/image all accept views,
+        # and at wire rates the two avoided full-frame copies are real time
+        payload = memoryview(body)[_CHUNK_HDR.size:]
+        if ci >= len(self._expect):
+            raise PeerWireError(f"chunk index {ci} out of range")
+        if off != self._expect[ci]:
+            raise PeerWireError(
+                f"out-of-order chunk {ci}: offset {off}, expected {self._expect[ci]}"
+            )
+        declared = int(self.meta["chunks"][ci]["nbytes"])
+        if off + len(payload) > declared:
+            raise PeerWireError(
+                f"chunk {ci} overruns declared length {declared}"
+            )
+        self._hashers[ci].update(payload)
+        # scatter the payload across the chunk's file segments
+        segs = self._segments[ci]
+        p = self._seg_ptr[ci]
+        cur = off
+        end = off + len(payload)
+        while cur < end:
+            while p < len(segs) and segs[p][0] + segs[p][2] <= cur:
+                p += 1
+            if p >= len(segs):
+                raise PeerWireError(f"chunk {ci} bytes beyond segment map")
+            seg_off, file_off, nb = segs[p]
+            take = min(end, seg_off + nb) - cur
+            dst = file_off + (cur - seg_off)
+            self._wq.put((dst, payload[cur - off:cur - off + take]))
+            if self.image is not None:
+                self.image[dst:dst + take] = np.frombuffer(
+                    payload, np.uint8, take, cur - off
+                )
+            cur += take
+        if self._werr:
+            raise PeerWireError(f"artifact write failed: {self._werr[0]}")
+        self._seg_ptr[ci] = p
+        self._expect[ci] = end
+        self.bytes_received += len(payload)
+        if end == declared:
+            digest = self._hashers[ci].hexdigest()[:32]
+            if digest != self.meta["chunks"][ci]["hash"]:
+                raise PeerWireError(f"chunk {ci} hash mismatch")
+            self._done[ci] = True
+        return "chunk"
+
+    def _write_loop(self) -> None:
+        while True:
+            item = self._wq.get()
+            if item is None:
+                return
+            if self._werr:
+                continue  # poisoned: drain so feed()'s put never deadlocks
+            dst, data = item
+            try:
+                self._fh.seek(dst)
+                self._fh.write(data)
+            except Exception as e:  # noqa: BLE001 - surfaced on next feed/end
+                self._werr.append(e)
+
+    def _join_writer(self) -> None:
+        if self._writer is not None:
+            self._wq.put(None)
+            self._writer.join()
+            self._writer = None
+            self._wq = None
+
+    def _on_end(self, body: bytes) -> str:
+        if self.meta is None:
+            raise PeerWireError("end frame before meta")
+        if not all(self._done):
+            missing = [i for i, d in enumerate(self._done) if not d]
+            raise PeerWireError(f"stream ended with incomplete chunks {missing}")
+        self._join_writer()
+        if self._werr:
+            raise PeerWireError(f"artifact write failed: {self._werr[0]}")
+        # no fsync: the store providers never fsync either — artifact
+        # completeness is the atomic rename's job, and a lost page-cache
+        # write after a crash is just a cold miss
+        self._fh.flush()
+        self._fh.close()
+        self._fh = None
+        return "end"
+
+    def build_entry(self) -> Any:
+        """Packed entry straight from the assembled RAM image — the same
+        ``PackedModelEntry`` a disk load would produce, minus the disk
+        read-back. Only valid after a clean end frame with
+        ``assemble=True``. ``jitted`` is left None; the runtime fills or
+        shares the family executable at adoption (model_runtime.py)."""
+        if self.image is None or self._fh is not None or self.meta is None:
+            raise PeerWireError("build_entry before a clean assembled stream")
+        from tfservingcache_tpu.models.registry import build, params_from_manifest
+        from tfservingcache_tpu.runtime.model_runtime import build_packed_entry
+
+        model_json = self.meta["model_json"]
+        md = build(model_json["family"], model_json.get("config"))
+        params = params_from_manifest(
+            model_json, self.image, raw_quant=True, src="peer stream"
+        )
+        # build_packed_entry re-packs with owned copies, so nothing retains
+        # a view into self.image
+        return build_packed_entry(md, params, jitted=None, hbm_bytes=0)
+
+    def close(self) -> None:
+        self._join_writer()
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            finally:
+                self._fh = None
+
+
+def fetch_from_peer(
+    channel,
+    name: str,
+    version: int,
+    dest_dir: str,
+    on_file=None,
+    timeout_s: float | None = None,
+    on_entry=None,
+) -> int:
+    """Synchronous client: stream ``name@version`` from the peer behind
+    ``channel`` (a sync ``grpc.insecure_channel``) into ``dest_dir``.
+    Returns bytes received. Raises ``grpc.RpcError`` on transport/peer
+    errors (callers classify NOT_FOUND vs real failure) and
+    ``PeerWireError`` on integrity failures.
+
+    ``on_entry``, when given, receives the transfer-ready
+    ``PackedModelEntry`` rebuilt from the stream's RAM image after a clean
+    end frame — the receiver's fast path past the artifact read-back. An
+    entry-build failure is swallowed (logged): the disk artifact is already
+    complete, so the caller just loads the slow way."""
+    call = channel.unary_stream(
+        PEER_FETCH_PATH,
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    rx = PeerStreamReceiver(dest_dir, assemble=on_entry is not None)
+    ended = False
+    try:
+        for frame in call(encode_request(name, version), timeout=timeout_s):
+            kind = rx.feed(frame)
+            if kind == "meta" and on_file is not None:
+                from tfservingcache_tpu.cache.providers.base import _notify_file
+
+                _notify_file(on_file, MODEL_JSON, rx.meta_path)
+            elif kind == "end":
+                ended = True
+        if not ended:
+            raise PeerWireError("peer stream closed without end frame")
+        if on_entry is not None:
+            try:
+                on_entry(rx.build_entry())
+            except Exception as e:  # noqa: BLE001 - artifact on disk is complete
+                log.warning(
+                    "packed-entry rebuild from peer stream failed (%s: %s); "
+                    "receiver will load from the landed artifact",
+                    type(e).__name__, e,
+                )
+        return rx.bytes_received
+    finally:
+        rx.close()
+
+
+class PeerSource:
+    """Outbound side: serves this node's host-tier entries to peers.
+
+    Attached to ``GrpcServingServer.peer_source`` post-construction (same
+    pattern as ``status_collector``); the server registers the
+    PeerTransfer service when present. Holds the per-requesting-peer
+    in-flight cap and the pin/unpin discipline around each stream
+    (ISSUE 8 satellite 1: an outbound read must neither perturb LRU order
+    nor race eviction)."""
+
+    def __init__(
+        self,
+        runtime: Any,
+        chunk_bytes: int = 2 << 20,
+        max_inflight_per_peer: int = 2,
+    ) -> None:
+        self.runtime = runtime
+        self.chunk_bytes = int(chunk_bytes)
+        self.max_inflight_per_peer = int(max_inflight_per_peer)
+        self._lock = threading.Lock()
+        self._inflight: dict[str, int] = {}
+
+    def acquire(self, peer_key: str) -> bool:
+        with self._lock:
+            n = self._inflight.get(peer_key, 0)
+            if n >= self.max_inflight_per_peer:
+                return False
+            self._inflight[peer_key] = n + 1
+            return True
+
+    def release(self, peer_key: str) -> None:
+        with self._lock:
+            n = self._inflight.get(peer_key, 0) - 1
+            if n <= 0:
+                self._inflight.pop(peer_key, None)
+            else:
+                self._inflight[peer_key] = n
+
+    def pin(self, model_id) -> Any | None:
+        tier = getattr(self.runtime, "_host_tier", None)
+        if tier is None:
+            return None
+        return tier.pin(model_id)
+
+    def unpin(self, model_id) -> None:
+        tier = getattr(self.runtime, "_host_tier", None)
+        if tier is not None:
+            tier.unpin(model_id)

@@ -376,7 +376,7 @@ def build_packed_entry(
     views into an artifact's decoded blob are copied rather than pinned.
     """
     from tfservingcache_tpu.cache.host_tier import PackedModelEntry
-    from tfservingcache_tpu.models.registry import QuantLeaf
+    from tfservingcache_tpu.models.registry import QuantLeaf, _leaf_path_str
 
     outer, treedef, arrs, owner = _flatten_for_pack(host_params)
     quant_dtypes = {
@@ -384,6 +384,16 @@ def build_packed_entry(
         for oi, leaf in enumerate(outer)
         if isinstance(leaf, QuantLeaf)
     }
+    # outer idx -> artifact leaf path, so a peer can synthesize a complete
+    # v2 manifest from this entry alone (protocol/peer_transfer.py). Same
+    # path convention as save_artifact; flatten order matches outer (both
+    # flatten the same tree with the same is_leaf).
+    import jax
+
+    paths_with_leaves = jax.tree_util.tree_flatten_with_path(
+        host_params, is_leaf=lambda x: isinstance(x, QuantLeaf)
+    )[0]
+    paths = [_leaf_path_str(kp) for kp, _ in paths_with_leaves]
     if captured:
         chunks = [(list(chunk), flat) for chunk, flat in captured]
     else:
@@ -405,6 +415,7 @@ def build_packed_entry(
         jitted=jitted,
         hbm_bytes=int(hbm_bytes),
         nbytes=sum(f.nbytes for _, f in chunks),
+        paths=paths,
     )
 
 
@@ -565,6 +576,14 @@ class TPUModelRuntime(BaseRuntime):
         )
         self._load_locks: dict[ModelId, threading.Lock] = {}
         self._load_locks_guard = threading.Lock()
+        # one-shot transfer-ready entries handed over by a peer fetch
+        # (CacheManager adopt, cache/providers/peer.py): the next _load of
+        # that model promotes straight from these chunks — no artifact
+        # read-back of bytes that just crossed the wire. Independent of the
+        # host tier on purpose: the fast first load must not depend on the
+        # warm-tier budget being enabled.
+        self._adopted: dict[ModelId, Any] = {}
+        self._adopted_lock = threading.Lock()
         # Host-RAM warm tier (cache/host_tier.py): packed transfer chunks +
         # executable handles of evicted models, so re-admission skips fetch
         # and decode and pays only the H2D stream. Off-mesh only, like the
@@ -648,8 +667,48 @@ class TPUModelRuntime(BaseRuntime):
                 return "hbm"
             return self._load(model)
 
+    def adopt_packed_entry(self, model_id: ModelId, entry: Any) -> None:
+        """Hand over a transfer-ready ``PackedModelEntry`` that did NOT come
+        from this runtime's own demotion — a peer fetch rebuilt it off the
+        wire (protocol/peer_transfer.py). The next ``_load`` of ``model_id``
+        consumes it via the promotion path: same pipelined device_put the
+        warm tier replays, skipping the artifact read-back. One-shot and
+        advisory: a mesh runtime drops it (group op streams must not depend
+        on per-process residency), and any promotion failure falls through
+        to the full disk load."""
+        if self.mesh is not None:
+            return
+        with self._adopted_lock:
+            self._adopted[model_id] = entry
+
+    def _fill_family_jit(self, entry: Any) -> None:
+        """A demoted entry carries the family's live jit handle; a
+        wire-adopted one can't. If the family executable is still resident
+        this is a no-op (_promote shares it); otherwise build the same jit
+        the disk path would so promotion installs a usable handle. Adoption
+        is gated off-mesh, so the plain (non-sharded-output) jit suffices."""
+        import jax
+
+        with self._jit_lock:
+            if entry.model_def.cache_key in self._jitted_by_key:
+                return
+        entry.jitted = jax.jit(entry.model_def.apply)
+
     def _load(self, model: Model) -> str:
         mid = model.identifier
+        with self._adopted_lock:
+            adopted = self._adopted.pop(mid, None)
+        if adopted is not None:
+            try:
+                if adopted.jitted is None:
+                    self._fill_family_jit(adopted)
+                self._promote(model, adopted)
+                return "host"
+            except Exception as e:  # noqa: BLE001 - full path still works
+                log.warning(
+                    "promotion of adopted entry for %s failed (%s); "
+                    "falling back to the full load path", mid, e,
+                )
         if self._host_tier is not None:
             entry = self._host_tier.get(mid)
             if entry is not None:
@@ -2155,6 +2214,8 @@ class TPUModelRuntime(BaseRuntime):
             self._host_tier.close()  # put() no-ops from here on
             self._demote_queue.put(None)  # worker exits after queued jobs
         self._resident.clear()
+        with self._adopted_lock:
+            self._adopted.clear()
         with self._slot_lock:
             self._slot_states.clear()
             self._slot_init_guards.clear()

@@ -56,6 +56,20 @@ class CacheNode:
         self.cfg = cfg
         self.metrics = Metrics(model_labels=cfg.metrics.model_labels)
         provider = create_provider(cfg.model_provider)
+        if cfg.cluster.peer_fetch:
+            # peer param distribution: front the store with the peer path
+            # (cache/providers/peer.py). Constructed UNBOUND — pure
+            # pass-through — until a Router arms it with the fleet view
+            # (single-node deployments never bind, and lose nothing).
+            from tfservingcache_tpu.cache.providers.peer import PeerProvider
+
+            provider = PeerProvider(
+                provider,
+                chunk_bytes=cfg.cluster.peer_fetch_chunk_bytes,
+                timeout_s=cfg.cluster.peer_fetch_timeout_s,
+                max_message_bytes=cfg.proxy.grpc_max_message_bytes,
+            )
+        self.provider = provider
         disk_cache = ModelDiskCache(cfg.cache.base_dir, cfg.cache.disk_capacity_bytes)
         self.disk_cache = disk_cache
 
@@ -174,6 +188,17 @@ class CacheNode:
             grpc = GrpcServingServer(
                 backend, self.metrics, cfg.proxy.grpc_max_message_bytes
             )
+            if cfg.cluster.peer_fetch:
+                # outbound half of the peer path: serve this group's
+                # host-tier packed entries to cold peers (the handler
+                # answers NOT_FOUND when the tier is off or empty)
+                from tfservingcache_tpu.protocol.peer_transfer import PeerSource
+
+                grpc.peer_source = PeerSource(
+                    rt,
+                    chunk_bytes=cfg.cluster.peer_fetch_chunk_bytes,
+                    max_inflight_per_peer=cfg.cluster.peer_fetch_max_inflight_per_peer,
+                )
             group = ServingGroup(i, manager, backend, rest, grpc)
             if cfg.cluster.status_exchange:
                 # per-group status collector for the fleet exchange; built
@@ -253,6 +278,9 @@ class CacheNode:
             await self.work_server.close()
         for mgr in self._follower_managers:
             mgr.close()
+        close_provider = getattr(self.provider, "close", None)
+        if close_provider is not None:
+            close_provider()
 
 
 async def serve(cfg: Config) -> None:

@@ -135,7 +135,7 @@ class Metrics:
         self.reload_source = Counter(
             "tpusc_reload_source",
             "ensure_servable resolutions by serving tier "
-            "(tier = hbm | host | disk | store)",
+            "(tier = hbm | host | disk | store | peer)",
             ["tier"], registry=r,
         )
         self.host_tier_bytes = Gauge(
@@ -338,6 +338,26 @@ class Metrics:
             "Nodes currently advertising this model at this residency tier "
             "(tier = hbm | host | disk), from the fleet status exchange",
             ["model", "tier"], registry=r,
+        )
+        # peer param distribution (cache/providers/peer.py): cold misses
+        # sourced from a warm peer's host tier instead of the store
+        self.peer_fetch_bytes = Counter(
+            "tpusc_peer_fetch_bytes",
+            "Packed parameter bytes streamed FROM peers on cold misses "
+            "(outcome = ok | error | not_found; error/not_found count the "
+            "bytes received before the stream gave up and fell back to "
+            "the store)",
+            ["outcome"], registry=r,
+        )
+        # load-adaptive replication (cluster/replication.py): the
+        # controller's desired per-model ring replica count N
+        self.model_replicas_target = Gauge(
+            "tpusc_model_replicas_target",
+            "Per-model ring replica count N the replica controller "
+            "currently targets (grows with in-flight load toward "
+            "cluster.max_replicas_per_model, decays to the "
+            "proxy.replicas_per_model floor with hysteresis)",
+            ["model"], registry=r,
         )
         self.spec_draft_autodisabled = Counter(
             "tpusc_spec_draft_autodisabled_total",
