@@ -278,6 +278,7 @@ SECTION_GROUPS = (
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
     "warm_tier", "peer_cold_start", "cold_pipeline", "paged_kv",
+    "shared_prefix",
 )
 
 
@@ -2162,6 +2163,154 @@ def bench_paged_kv(tmp: str, lm_config: dict) -> dict:
     return out
 
 
+def bench_shared_prefix(tmp: str, lm_config: dict) -> dict:
+    """Sharing-off vs sharing-on paged KV at the SAME arena budget on the
+    same seeded Poisson swarm of requests carrying one long system prompt
+    plus short unique suffixes — the serving shape the radix index is
+    for. Off, every row prefills and stores the system prompt privately;
+    on, the first admission publishes its prompt pages and every later
+    row maps them read-only (suffix-only prefill, CoW on divergence).
+    Reported per arm: peak admitted concurrent slots (the acceptance
+    headline: >= 2x), p50/p95 TTFT, tok/s; the on-arm additionally
+    reports the radix hit split and the page-conservation census at
+    drain."""
+    import threading
+
+    import numpy as np
+
+    from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    manager, runtime = _make_stack("transformer_lm", 1, tmp, config=lm_config)
+    mid = ModelId("tenant0", 1)
+    manager.ensure_servable(mid)
+
+    chunk, page_tokens, slots = 4, 16, 16
+    sys_pages = 8                       # 128-token shared system prompt
+    sys_len = sys_pages * page_tokens
+    # per-row private need: ~16-token suffix + <=16 new -> 2-3 pages; the
+    # off arm needs sys_pages + 3 per row. Arena sized so the off arm fits
+    # ~2 rows and the on arm is gated only by its private tail.
+    arena_pages = 2 * (sys_pages + 3) + 2
+
+    n_req = 24
+    vocab = lm_config["vocab_size"]
+    r = np.random.default_rng(42)
+    system = r.integers(0, vocab, sys_len).astype(np.int32)
+    reqs = [
+        (
+            np.concatenate(
+                [system, r.integers(0, vocab, int(r.integers(8, 17)))]
+            ).astype(np.int32),
+            int(r.integers(4, 17)),
+        )
+        for _ in range(n_req)
+    ]
+    arrivals = np.cumsum(r.exponential(0.02, n_req))
+
+    def replay(gen_fn) -> tuple[list, float]:
+        results: list = [None] * n_req
+        errors: list = []
+
+        def client(i):
+            prompt, max_new = reqs[i]
+            try:
+                results[i] = gen_fn(prompt, max_new)
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        start = time.perf_counter()
+        for i in range(n_req):
+            delay = arrivals[i] - (time.perf_counter() - start)
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        if errors:
+            raise RuntimeError(f"{len(errors)} failed: {errors[:3]}")
+        return results, wall
+
+    def run_arm(share_bytes: int) -> dict:
+        metrics = Metrics()
+        eng = ContinuousGenerateEngine(
+            runtime, slots=slots, chunk_tokens=chunk, metrics=metrics,
+            page_tokens=page_tokens, arena_pages=arena_pages,
+            share_prefix_bytes=share_bytes,
+        )
+        try:
+            # warm the compiled prefill/insert/chunk programs off-window
+            # (an UNSHARED prompt so the index stays cold for the swarm)
+            eng.generate(mid, np.ones((1, 16), np.int32), max_new_tokens=4)
+            eng.peak_active = 0
+
+            def fn(prompt, max_new):
+                _, stats = eng.generate(
+                    mid, prompt[None], max_new_tokens=max_new,
+                    return_stats=True,
+                )
+                return stats[0]["ttft_s"], stats[0]["tokens"]
+
+            results, wall = replay(fn)
+            ttfts = sorted(t for t, _ in results)
+            toks = sum(n for _, n in results)
+            out = {
+                "peak_admitted_slots": eng.peak_active,
+                "p50_ttft_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+                "p95_ttft_ms": round(
+                    ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] * 1e3,
+                    1,
+                ),
+                "tok_s": round(toks / wall, 1),
+                "wall_s": round(wall, 2),
+                "tokens": toks,
+            }
+            st = runtime._slot_states[mid]
+            if share_bytes:
+                idx = st.prefix_index
+                out["radix"] = {
+                    "hits": idx.hits, "exact_hits": idx.exact_hits,
+                    "misses": idx.misses,
+                }
+            # free-list/refcount census must balance at drain — a sharing
+            # bug shows up here as a leaked or double-freed page
+            st.check_page_conservation()
+            stats_pages = (
+                st.page_stats() if hasattr(st, "page_stats")
+                else {"free": len(st.free_pages)}
+            )
+            out["pages_at_drain"] = stats_pages
+            out["conservation_ok"] = True
+            return out
+        finally:
+            eng.close()
+            runtime.drop_slot_state(mid)  # next arm allocates its own layout
+
+    out = {
+        "requests": n_req,
+        "system_prompt_tokens": sys_len,
+        "page_tokens": page_tokens,
+        "arena_pages": arena_pages,
+        "sharing_off": run_arm(0),
+        "sharing_on": run_arm(1 << 30),
+    }
+    out["admitted_slots_ratio"] = round(
+        out["sharing_on"]["peak_admitted_slots"]
+        / max(1, out["sharing_off"]["peak_admitted_slots"]), 2
+    )
+    out["ttft_p50_ratio"] = round(
+        out["sharing_on"]["p50_ttft_ms"]
+        / max(1e-9, out["sharing_off"]["p50_ttft_ms"]), 3
+    )
+    manager.close()
+    return out
+
+
 def watcher_liveness() -> dict:
     """Probe-history summary from the watcher's state file + log, embedded
     into EVERY bench artifact — even a CPU-fallback run self-reports whether
@@ -2226,7 +2375,7 @@ def collect_watcher_evidence() -> dict:
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
         "continuous_batching", "zoo_cold", "warm_tier", "cold_pipeline",
-        "paged_kv",
+        "paged_kv", "shared_prefix",
         "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
@@ -2555,6 +2704,15 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["paged_kv"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("shared_prefix"):
+        try:
+            with _section("shared_prefix"):
+                detail["shared_prefix"] = bench_shared_prefix(
+                    os.path.join(tmp, "sharedprefix"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["shared_prefix"] = {"error": f"{type(e).__name__}: {e}"}
 
     _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):

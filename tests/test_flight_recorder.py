@@ -495,3 +495,30 @@ def test_engine_dump_tool_renders_dump(tmp_path, capsys):
     # --latest resolves the newest dump in a dir
     assert mod.main(["--latest", str(tmp_path)]) == 0
     assert mod.main(["--latest", str(tmp_path / "empty")]) == 1
+
+
+def test_ring_and_dump_render_shared_prefix_split(tmp_path, capsys):
+    """Shared-prefix telemetry rides the step ring: the window summarizes
+    radix hit rate over admissions and peak shared pages, and the dump
+    tool renders the shared/private/free page split per step plus the
+    hit-rate line."""
+    fr = FlightRecorder(flight_dir=str(tmp_path))
+    fr.record("m@1", "continuous", step_ms=1.0, chunk=8, active=2,
+              admitted=2, retired=0, pages_used=6, pages_free=10,
+              pages_shared=2, prefix_hits=1)
+    fr.record("m@1", "continuous", step_ms=1.0, chunk=8, active=3,
+              admitted=2, retired=1, pages_used=8, pages_free=8,
+              pages_shared=3, prefix_hits=2)
+    snap = fr.snapshot(tail=16)
+    win = snap["models"]["m@1"]["window"]
+    assert win["admitted"] == 4
+    assert win["prefix_hits"] == 3
+    assert win["prefix_hit_rate"] == pytest.approx(3 / 4)
+    assert win["max_pages_shared"] == 3
+    path = fr.dump("slo_breach", dedup_key=("slo", "share"))
+    mod = _load_engine_dump_module()
+    assert mod.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "prefix sharing: 3/4 admissions hit (rate=0.750)" in out
+    assert "max shared pages=3" in out
+    assert "pages=3s+5p/8f" in out  # 8 used = 3 shared + 5 private, 8 free

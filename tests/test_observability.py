@@ -560,9 +560,11 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_evictions",
     "tpusc_gen_admission_wait_seconds",
     "tpusc_gen_kv_page_waste_tokens",
+    "tpusc_gen_kv_pages_shared",
     "tpusc_gen_kv_pages_total",
     "tpusc_gen_kv_pages_used",
     "tpusc_gen_kv_pages_used_peak",
+    "tpusc_gen_prefix_hits",
     "tpusc_gen_oldest_queued_age_seconds",
     "tpusc_gen_slots_active",
     "tpusc_gen_wasted_steps",

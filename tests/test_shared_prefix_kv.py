@@ -1,0 +1,315 @@
+"""Cross-request shared-prefix KV over the paged arena
+(`serving.kv_share_prefix_bytes` > 0): radix-index bookkeeping (insert
+dedup, budget eviction, pressure reclaim with protect sets), greedy
+token parity sharing-on vs sharing-off, exact-hit prefill skip with
+first-token sampling parity, seeded sampling parity through the
+copy-on-write boundary path, refcount conservation under admission
+pressure, and the no-new-decode-programs guarantee."""
+
+import numpy as np
+
+import tfservingcache_tpu.models.generation as generation
+import tfservingcache_tpu.runtime.batcher as batcher_mod
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.runtime.prefix_cache import PagePrefixIndex
+from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.metrics import Metrics
+
+TINY = {
+    "vocab_size": 97,
+    "d_model": 48,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 96,
+    "max_seq": 64,
+}
+
+PT = 8
+SHARE = 1 << 30  # effectively unbounded index byte budget
+
+
+def _load(tmp_path, name="lm", config=TINY, metrics=None, **serving_kw):
+    export_artifact("transformer_lm", str(tmp_path), name=name, version=1,
+                    config=config)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu", **serving_kw), metrics)
+    mid = ModelId(name, 1)
+    rt.ensure_loaded(Model(identifier=mid, path=str(tmp_path / name / "1")))
+    return rt, mid
+
+
+def _slot_state(rt, mid):
+    return rt._slot_states[mid]
+
+
+def _swarm(rows, sfx=3, seed=11):
+    """Same 2-page system prompt on every row, unique short suffixes —
+    the canonical shared-prefix serving shape."""
+    rng = np.random.default_rng(seed)
+    system = rng.integers(1, TINY["vocab_size"], 2 * PT).astype(np.int32)
+    ids = np.zeros((rows, 2 * PT + sfx), np.int32)
+    for r in range(rows):
+        ids[r] = np.concatenate(
+            [system, rng.integers(1, TINY["vocab_size"], sfx)]
+        )
+    return ids, [2 * PT + sfx] * rows
+
+
+def _prom(m, kind):
+    return m.registry.get_sample_value(
+        "tpusc_gen_prefix_hits_total",
+        {"engine": "continuous", "kind": kind},
+    ) or 0
+
+
+# -- radix index unit tests ---------------------------------------------------
+
+def test_radix_insert_lookup_and_dedup():
+    """Insert publishes full pages + a boundary copy; exact lookup returns
+    the whole plan, a longer prompt gets a partial plan, a re-publish of
+    the same prompt dedups onto the existing nodes (no double ref)."""
+    idx = PagePrefixIndex(page_tokens=4, page_nbytes=64,
+                          capacity_bytes=1 << 20)
+    refs = np.zeros(32, np.int32)
+    prompt = np.arange(1, 11, dtype=np.int32)  # 2 full pages + 2-token tail
+    logits = np.zeros((1, 5), np.float32)
+
+    added, released = idx.insert(prompt, [3, 4], 7, logits, refs)
+    assert sorted(added) == [3, 4, 7] and released == []
+    for pg in added:
+        refs[pg] += 1
+
+    plan = idx.lookup(prompt)
+    assert plan is not None and plan.kind == "exact"
+    assert plan.pages == [3, 4] and plan.boundary_page == 7
+    assert plan.tail_len == 2 and plan.logits is not None
+    assert plan.mapped_pages() == [3, 4, 7]
+    assert idx.exact_hits == 1
+
+    longer = np.concatenate([prompt[:8], np.arange(50, 55, dtype=np.int32)])
+    plan = idx.lookup(longer)
+    assert plan is not None and plan.kind == "shared"
+    assert plan.pages == [3, 4] and plan.covered == 8
+
+    # duplicate publisher: existing nodes keep THEIR pages, nothing added
+    added, released = idx.insert(prompt, [9, 10], 11, logits, refs)
+    assert added == [] and released == []
+    assert idx.held_pages() == {3: 1, 4: 1, 7: 1}
+
+    # unindexed first chunk -> miss
+    assert idx.lookup(np.arange(60, 70, dtype=np.int32)) is None
+    assert idx.misses == 1
+
+
+def test_radix_page_aligned_prompt_needs_one_suffix_token():
+    """A page-aligned prompt with no cached tail must come back one page
+    short (strict prefix: the forward needs a non-empty suffix block)."""
+    idx = PagePrefixIndex(page_tokens=4, page_nbytes=64,
+                          capacity_bytes=1 << 20)
+    refs = np.zeros(8, np.int32)
+    prompt = np.arange(1, 9, dtype=np.int32)  # exactly 2 pages
+    added, _ = idx.insert(prompt, [1, 2], None, None, refs)
+    for pg in added:
+        refs[pg] += 1
+    plan = idx.lookup(prompt)
+    assert plan is not None and plan.kind == "shared"
+    assert plan.pages == [1] and plan.covered == 4
+
+
+def test_radix_budget_evicts_coldest_zero_ref_leaf():
+    idx = PagePrefixIndex(page_tokens=4, page_nbytes=64, capacity_bytes=64)
+    refs = np.zeros(8, np.int32)
+    added, released = idx.insert(np.arange(4, dtype=np.int32), [1], None,
+                                 None, refs)
+    assert added == [1] and released == []
+    refs[1] += 1
+    added, released = idx.insert(np.arange(4, 8, dtype=np.int32), [2], None,
+                                 None, refs)
+    assert added == [2]
+    assert released == [1]  # over budget: coldest zero-ref leaf goes
+    assert idx.held_pages() == {2: 1}
+
+
+def test_radix_reclaim_skips_lane_refs_and_protect():
+    """Pressure reclaim only releases pages no lane maps, and never the
+    blocked request's own share plan."""
+    idx = PagePrefixIndex(page_tokens=4, page_nbytes=64,
+                          capacity_bytes=1 << 20)
+    refs = np.zeros(8, np.int32)
+    for start, pg in ((0, 1), (10, 2), (20, 3)):
+        added, _ = idx.insert(np.arange(start, start + 4, dtype=np.int32),
+                              [pg], None, None, refs)
+        assert added == [pg]
+        refs[pg] += 1
+    refs[2] += 1  # a live lane still maps page 2
+    out = idx.reclaim(refs, want_pages=3, protect=frozenset({3}))
+    assert out == [1]
+    assert idx.held_pages() == {2: 1, 3: 1}
+
+
+# -- engine-level parity ------------------------------------------------------
+
+def test_greedy_parity_sharing_on_vs_off(tmp_path):
+    """Same-system-prompt swarm decodes token-identically whether the
+    prefix pages are shared or privately prefilled, and sharing actually
+    engaged (every row after the first admits through the radix index)."""
+    rows = 5
+    ids, lens = _swarm(rows)
+    outs = []
+    m = Metrics()
+    for arm, share, metrics in (("off", 0, None), ("on", SHARE, m)):
+        rt, mid = _load(tmp_path / arm, metrics=metrics)
+        eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                       metrics=metrics,
+                                       page_tokens=PT, arena_pages=48,
+                                       share_prefix_bytes=share)
+        try:
+            outs.append(eng.generate(mid, ids, prompt_lengths=lens,
+                                     max_new_tokens=6))
+            st = _slot_state(rt, mid)
+            if share:
+                assert st.prefix_index is not None
+                # first row misses and publishes; the rest map its pages
+                assert _prom(m, "shared") == rows - 1
+                st.check_page_conservation()
+                stats = st.page_stats()
+                assert stats["shared"] == 0 and stats["private"] == 0
+                assert stats["cached"] > 0  # index retains the prefix
+                # used gauge excludes reclaimable cache pages: admission
+                # headroom is not under-reported (satellite 2)
+                assert m.registry.get_sample_value(
+                    "tpusc_gen_kv_pages_used") == 0
+                assert m.registry.get_sample_value(
+                    "tpusc_gen_kv_pages_shared") == 0
+            else:
+                assert getattr(st, "prefix_index", None) is None
+        finally:
+            eng.close()
+            rt.close()
+    assert (outs[0] == outs[1]).all()
+
+
+def test_exact_hit_skips_prefill_and_matches(tmp_path):
+    """A byte-identical re-admission is an EXACT hit: no prefill compute
+    (first token sampled from the cached boundary logits), identical
+    greedy output, and the arena stays conserved with the boundary page
+    copy-on-write'd at admission."""
+    ids, lens = _swarm(rows=1)
+    m = Metrics()
+    rt, mid = _load(tmp_path, metrics=m)
+    eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=4, metrics=m,
+                                   page_tokens=PT, arena_pages=24,
+                                   share_prefix_bytes=SHARE)
+    try:
+        first = eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+        st = _slot_state(rt, mid)
+        # publisher left 2 full pages + 1 pristine boundary copy behind
+        assert st.page_stats()["cached"] == 3
+        again = eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+        assert (again == first).all()
+        assert st.prefix_index.exact_hits == 1
+        assert _prom(m, "exact") == 1
+        st.check_page_conservation()
+        assert st.page_stats()["cached"] == 3
+    finally:
+        eng.close()
+        rt.close()
+
+
+def test_sampling_parity_sharing_on_vs_off(tmp_path, monkeypatch):
+    """Sampled decode (temperature > 0) through the sharing paths —
+    including a duplicate prompt that admits via the exact-hit
+    cached-logits sample and CoWs the shared boundary page before
+    diverging — must match the sharing-off engine token-for-token under
+    pinned prefill seeds."""
+    ids, lens = _swarm(rows=2, seed=5)
+    dup = np.vstack([ids[0], ids[0], ids[1]])  # row 1 duplicates row 0
+    sampling = [(0.8, 5), (0.8, 5), (1.3, 3)]
+
+    def run(arm_dir, share):
+        counter = iter(range(1000))
+        monkeypatch.setattr(
+            batcher_mod.secrets, "randbits", lambda _b: next(counter)
+        )
+        rt, mid = _load(arm_dir)
+        eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                       page_tokens=PT, arena_pages=48,
+                                       share_prefix_bytes=share)
+        try:
+            reqs = [
+                batcher_mod._ContinuousReq(
+                    prompt=dup[r].copy(), max_new=6, temperature=t, top_k=k,
+                )
+                for r, (t, k) in enumerate(sampling)
+            ]
+            eng._sched(mid).submit(reqs)
+            for r in reqs:
+                assert r.done.wait(60.0)
+                assert r.error is None
+            st = _slot_state(rt, mid)
+            if share:
+                assert st.prefix_index.exact_hits >= 1
+                st.check_page_conservation()
+            return [list(r.tokens) for r in reqs]
+        finally:
+            eng.close()
+            rt.close()
+
+    off = run(tmp_path / "off", 0)
+    on = run(tmp_path / "on", SHARE)
+    assert off == on
+
+
+# -- pressure / conservation --------------------------------------------------
+
+def test_conservation_under_reclaim_pressure(tmp_path):
+    """Churn a swarm through an arena too small to also keep the index
+    warm: admissions reclaim cold index pages instead of deadlocking,
+    every row completes, sharing lifts concurrency above the private-page
+    ceiling, and the free-list/refcount census balances at drain."""
+    rows = 12
+    ids, lens = _swarm(rows, seed=3)
+    m = Metrics()
+    rt, mid = _load(tmp_path, metrics=m)
+    # budget/row = 19 + 6 -> 4 pages: privately 8 pages fit 2 rows; with
+    # the 2 system pages shared, 3+ rows fit
+    eng = ContinuousGenerateEngine(rt, slots=6, chunk_tokens=4, metrics=m,
+                                   page_tokens=PT, arena_pages=8,
+                                   share_prefix_bytes=SHARE)
+    try:
+        out = eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+        assert out.shape == (rows, 6)
+        assert eng.admitted == rows
+        assert eng.peak_active >= 3  # above the 2-row private ceiling
+        st = _slot_state(rt, mid)
+        st.check_page_conservation()
+        stats = st.page_stats()
+        assert stats["shared"] == 0 and stats["private"] == 0
+        assert stats["free"] + stats["cached"] == st.arena_pages
+        assert m.registry.get_sample_value("tpusc_gen_kv_pages_used") == 0
+    finally:
+        eng.close()
+        rt.close()
+
+
+def test_decode_chunk_program_count_unchanged(tmp_path):
+    """Sharing must not mint new decode-chunk programs: block tables are
+    traced as data, so the sharing-on engine reuses the sharing-off
+    engine's compiled chunk executables exactly."""
+    ids, lens = _swarm(rows=3, seed=7)
+    for arm, share in (("off", 0), ("on", SHARE)):
+        rt, mid = _load(tmp_path / arm)
+        eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                       page_tokens=PT, arena_pages=48,
+                                       share_prefix_bytes=share)
+        try:
+            eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+        finally:
+            eng.close()
+            rt.close()
+        if arm == "off":
+            baseline = generation._paged_decode_chunk_jit._cache_size()
+    assert generation._paged_decode_chunk_jit._cache_size() == baseline

@@ -100,6 +100,7 @@ class NodeStatus:
     goodput: float = 1.0
     kv_pages_free: int = 0
     kv_pages_total: int = 0
+    kv_pages_shared: int = 0   # distinct multi-owner (shared-prefix) pages
     host_tier_bytes: int = 0
     models_resident: int = 0
     truncated: int = 0  # models dropped from ``models`` to fit the byte cap
@@ -116,6 +117,7 @@ class NodeStatus:
             "goodput": round(self.goodput, 4),
             "kv_pages_free": self.kv_pages_free,
             "kv_pages_total": self.kv_pages_total,
+            "kv_pages_shared": self.kv_pages_shared,
             "host_tier_bytes": self.host_tier_bytes,
             "models_resident": self.models_resident,
             "truncated": self.truncated,
@@ -143,6 +145,7 @@ class NodeStatus:
                 goodput=float(d.get("goodput", 1.0)),
                 kv_pages_free=int(d.get("kv_pages_free", 0)),
                 kv_pages_total=int(d.get("kv_pages_total", 0)),
+                kv_pages_shared=int(d.get("kv_pages_shared", 0)),
                 host_tier_bytes=int(d.get("host_tier_bytes", 0)),
                 models_resident=int(d.get("models_resident", 0)),
                 truncated=int(d.get("truncated", 0)),
@@ -293,10 +296,15 @@ class StatusCollector:
         m = self.metrics
         if m is not None:
             st.inflight = int(_gauge_sum(m.requests_in_flight))
+            # gen_kv_pages_used counts DISTINCT pages and excludes
+            # index-only cached pages (reclaimable on demand), so
+            # total - used is the node's true admission headroom even when
+            # shared-prefix KV multiplies the lanes behind each page
             used = _gauge_value(m.gen_kv_pages_used)
             total = _gauge_value(m.gen_kv_pages_total)
             st.kv_pages_total = int(total)
             st.kv_pages_free = max(0, int(total - used))
+            st.kv_pages_shared = int(_gauge_value(m.gen_kv_pages_shared))
             st.host_tier_bytes = int(_gauge_value(m.host_tier_bytes))
         return st
 
@@ -441,6 +449,7 @@ class FleetView:
                     goodput=st.goodput,
                     kv_pages_free=st.kv_pages_free,
                     kv_pages_total=st.kv_pages_total,
+                    kv_pages_shared=st.kv_pages_shared,
                     host_tier_bytes=st.host_tier_bytes,
                     models_resident=st.models_resident,
                     models_truncated=st.truncated,

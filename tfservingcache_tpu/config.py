@@ -100,6 +100,17 @@ class ServingConfig:
     # byte budget; shrink it to cap KV HBM, grow it (with generate_slots) to
     # admit more concurrent rows at the same budget.
     kv_arena_pages: int = 0
+    # Cross-request shared-prefix KV over the paged arena
+    # (runtime/prefix_cache.py PagePrefixIndex): byte budget of arena pages
+    # the radix prefix index may pin for reuse. 0 = off (default). > 0 (and
+    # kv_page_tokens > 0) makes admission map the longest page-aligned
+    # shared prompt prefix read-only into a new row's block table (refcount
+    # bump, no prefill compute over the shared part, no page copy) and
+    # reserve only the private suffix + max_new pages — N concurrent
+    # same-system-prompt rows pay O(1) arena memory for the prefix. Pages a
+    # lane would write into are copy-on-write; index-held pages are
+    # reclaimed under admission pressure before a request is ever blocked.
+    kv_share_prefix_bytes: int = 0
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

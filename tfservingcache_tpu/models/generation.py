@@ -231,9 +231,13 @@ def _slot_prefill_jit(
 ):
     """Prefill ONE prompt into a fresh (1, S_pad)-row cache and sample the
     request's first token — the admission half of the continuous engine.
-    Returns (first_tok (1,), k, v); the first token's own K/V is NOT yet in
-    the cache (it sits at pos=prompt_len, written by the first decode-chunk
-    step — the same convention as ``_decode_scan``'s first_tok)."""
+    Returns (first_tok (1,), k, v, last_logits (1, V) f32); the first
+    token's own K/V is NOT yet in the cache (it sits at pos=prompt_len,
+    written by the first decode-chunk step — the same convention as
+    ``_decode_scan``'s first_tok). The last-position logits ride along so
+    the shared-prefix index can cache them: an exact re-admission of the
+    same prompt samples its first token from these under its own seed and
+    skips prefill compute entirely."""
     cfg = dict(cfg_key)
     b, s_max = input_ids.shape
     cache = init_cache(cfg, b, s_max)
@@ -243,7 +247,7 @@ def _slot_prefill_jit(
     last = jnp.take_along_axis(logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
     _, sub = jax.random.split(rng)
     tok = _sample(last, sub, temperature, top_k)
-    return tok, cache["k"], cache["v"]
+    return tok, cache["k"], cache["v"], last
 
 
 @functools.partial(jax.jit, static_argnames=("cfg_key", "family"))
@@ -263,7 +267,8 @@ def _slot_prefill_from_cache_jit(
 ):
     """Admission prefill continuing from a prefix-cache hit: copy the prefix
     rows, prefill only the suffix, sample the first token. Same junk-row
-    safety argument as ``_generate_from_cache_jit``."""
+    safety argument as ``_generate_from_cache_jit``. Returns the
+    last-position logits too (same contract as ``_slot_prefill_jit``)."""
     cfg = dict(cfg_key)
     b, s_pad = suffix_ids.shape
     l_pad = cached_k.shape[3]
@@ -285,7 +290,18 @@ def _slot_prefill_from_cache_jit(
     )[:, 0]
     _, sub = jax.random.split(rng)
     tok = _sample(last, sub, temperature, top_k)
-    return tok, cache["k"], cache["v"]
+    return tok, cache["k"], cache["v"], last
+
+
+@jax.jit
+def _sample_logits_jit(last, rng, temperature, top_k):
+    """Sample one first token from CACHED last-position logits — the
+    shared-prefix index's exact-hit path, replacing the whole prefill
+    dispatch. The split-then-sample sequence is byte-identical to
+    ``_slot_prefill_jit``'s tail, so an exact hit and a cold prefill of
+    the same prompt produce the same token under the same seed."""
+    _, sub = jax.random.split(rng)
+    return _sample(last, sub, temperature, top_k)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -429,7 +445,7 @@ def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
 @functools.partial(
     jax.jit, donate_argnums=(0, 1), static_argnames=("page_tokens",)
 )
-def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, *, page_tokens):
+def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, base, *, page_tokens):
     """Scatter one admitted request's prefill K/V (layers, 1, n_kv, P_pad,
     hd) into its reserved pages: logical row ``r`` goes to page
     ``table_row[r // page_tokens]`` offset ``r % page_tokens``. ``table_row``
@@ -437,12 +453,18 @@ def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, *, page_tokens):
     the reservation are 0, so prefill-pad rows past the reserved budget
     (P_pad is a pow2 bucket and can overshoot it) land in the trash page.
     Junk pad rows inside the reservation are never visible for the same
-    write-before-read reason as the dense insert. One compile per P_pad
-    bucket, same bound as the prefill itself."""
+    write-before-read reason as the dense insert. ``base`` (traced i32) is
+    the shared-prefix boundary: rows < base belong to pages another lane /
+    the prefix index owns READ-ONLY, so their scatter is redirected to the
+    trash page — prefill stops at the shared boundary and only private
+    pages are written. base=0 is the plain unshared insert. One compile
+    per P_pad bucket, same bound as the prefill itself (base is data, not
+    a signature)."""
     p_pad = pk.shape[3]
     pps = table_row.shape[0]
     rows = jnp.arange(p_pad)
     pages = table_row[jnp.clip(rows // page_tokens, 0, pps - 1)]  # (P_pad,)
+    pages = jnp.where(rows >= base.astype(jnp.int32), pages, 0)
     offs = rows % page_tokens
     # (layers, 1, n_kv, P_pad, hd) -> (P_pad, layers, n_kv, hd): the two
     # advanced indices below are non-adjacent, so their broadcast dim moves
@@ -451,6 +473,34 @@ def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, *, page_tokens):
     vv = pv[:, 0].transpose(2, 0, 1, 3)
     arena_k = arena_k.at[:, pages, :, offs, :].set(kv.astype(arena_k.dtype))
     arena_v = arena_v.at[:, pages, :, offs, :].set(vv.astype(arena_v.dtype))
+    return arena_k, arena_v
+
+
+@jax.jit
+def _paged_gather_prefix_jit(arena_k, arena_v, pages):
+    """Gather ``n`` full shared-prefix pages into the dense
+    (layers, 1, n_kv, n*page_tokens, hd) layout `_slot_prefill_from_cache_jit`
+    expects as its cached prefix. Read-only on the arena (no donation — the
+    shared pages stay live for every other referencing lane). One compile
+    per distinct page count, bounded by pages_per_slot."""
+    # arena: (layers, n_pages, n_kv, page_tokens, hd); pages: (n,) i32
+    k = arena_k[:, pages]                       # (L, n, n_kv, pt, hd)
+    v = arena_v[:, pages]
+    layers, n, n_kv, pt, hd = k.shape
+    k = k.swapaxes(1, 2).reshape(layers, n_kv, n * pt, hd)[:, None]
+    v = v.swapaxes(1, 2).reshape(layers, n_kv, n * pt, hd)[:, None]
+    return k, v
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _page_copy_jit(arena_k, arena_v, src, dst):
+    """Copy one arena page ``src`` -> ``dst`` in place (donated buffers, no
+    arena-sized copy). This is the copy-on-write fast path: the host swaps
+    the lane's block-table entry to ``dst`` afterwards and decrefs ``src``.
+    ``src``/``dst`` are traced scalars, so every CoW event reuses the single
+    compiled program — the decode-chunk program count is untouched."""
+    arena_k = arena_k.at[:, dst].set(arena_k[:, src])
+    arena_v = arena_v.at[:, dst].set(arena_v[:, src])
     return arena_k, arena_v
 
 

@@ -73,6 +73,7 @@ class LocalServingBackend(ServingBackend):
         generate_chunk_tokens: int = 8,
         kv_page_tokens: int = 0,
         kv_arena_pages: int = 0,
+        kv_share_prefix_bytes: int = 0,
     ) -> None:
         self.manager = manager
         # JAX dispatch is effectively serialized per device; a few workers
@@ -119,6 +120,7 @@ class LocalServingBackend(ServingBackend):
                 metrics=manager.metrics,
                 page_tokens=kv_page_tokens,
                 arena_pages=kv_arena_pages,
+                share_prefix_bytes=kv_share_prefix_bytes,
             )
 
     async def _run(self, fn, *args):

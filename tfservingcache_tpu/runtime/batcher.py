@@ -750,6 +750,7 @@ class _ContinuousScheduler:
         admitted_any = False
         admitted_n = 0
         retired_n = 0
+        prefix_hits_n = 0
         while free:
             with self.cv:
                 if not self.pending:
@@ -760,15 +761,20 @@ class _ContinuousScheduler:
             reserved_idx = None
             try:
                 if state is None:
-                    if eng.page_tokens is None:
+                    if eng.page_tokens is None and \
+                            eng.share_prefix_bytes is None:
                         # no engine-level override: the runtime's ServingConfig
                         # decides (and stub runtimes keep their 2-arg surface)
                         state = rt.slot_decode_state(self.model_id, eng.slots)
                     else:
+                        kw = {}
+                        if eng.page_tokens is not None:
+                            kw["page_tokens"] = eng.page_tokens
+                            kw["arena_pages"] = eng.arena_pages
+                        if eng.share_prefix_bytes is not None:
+                            kw["share_prefix_bytes"] = eng.share_prefix_bytes
                         state = rt.slot_decode_state(
-                            self.model_id, eng.slots,
-                            page_tokens=eng.page_tokens,
-                            arena_pages=eng.arena_pages,
+                            self.model_id, eng.slots, **kw
                         )
                 p = req.prompt.shape[0]
                 if p + req.max_new > state.max_seq:
@@ -778,6 +784,9 @@ class _ContinuousScheduler:
                     )
                     req.done.set()
                     continue
+                plan = None
+                kind = None
+                share = getattr(state, "prefix_index", None) is not None
                 if getattr(state, "paged", False):
                     # admission is gated on free PAGES, not just free lanes:
                     # the row's whole prompt + max_new budget is reserved up
@@ -793,13 +802,43 @@ class _ContinuousScheduler:
                         req.done.set()
                         continue
                     idx = free[-1]  # the lane free.pop() will hand out below
-                    if not state.reserve_pages(idx, budget):
+                    shared_pages = ()
+                    cow_headroom = 0
+                    if share:
+                        plan = rt.shared_prefix_plan(state, req.prompt)
+                        if plan is not None:
+                            # map the indexed prefix read-only; reserve only
+                            # the private remainder. An exact hit with a
+                            # mid-page tail also needs one CoW page in hand
+                            # — its first decode write lands in the shared
+                            # boundary page.
+                            shared_pages = plan.mapped_pages()
+                            if plan.kind == "exact" and plan.tail_len > 0:
+                                cow_headroom = 1
+                    ok = state.reserve_pages(
+                        idx, budget, shared_pages, cow_headroom
+                    )
+                    if not ok and share:
+                        # page pressure: cold index-only prefix pages must
+                        # lose the fight to a live admission (protecting the
+                        # plan's own mapped pages), else sharing would turn
+                        # the blocks-never-fails queue into a deadlock
+                        want = (max(0, need - len(shared_pages)) + cow_headroom
+                                - len(state.free_pages))
+                        if want > 0 and rt.reclaim_prefix_pages(
+                            state, want, shared_pages
+                        ):
+                            ok = state.reserve_pages(
+                                idx, budget, shared_pages, cow_headroom
+                            )
+                    if not ok:
                         # arena exhausted: the queue BLOCKS, never fails —
                         # the row goes back to the FRONT (FIFO preserved)
                         # and retirements below recycle pages for the next
                         # chunk boundary's retry. Can't deadlock: with no
-                        # active lanes every page is free and need <=
-                        # arena_pages was checked above.
+                        # active lanes every page is free or reclaimable
+                        # from the prefix index, and need <= arena_pages
+                        # was checked above.
                         with self.cv:
                             self.pending.appendleft(req)
                             if eng.metrics is not None:
@@ -814,10 +853,19 @@ class _ContinuousScheduler:
                         break
                     reserved_idx = idx
                 pf0 = time.monotonic()
-                tok, pk, pv, hit = rt.slot_prefill(
-                    self.model_id, req.prompt, req.temperature, req.top_k,
-                    seed=secrets.randbits(31),
-                )
+                seed = secrets.randbits(31)
+                if share:
+                    tok, pk, pv, kind, last = rt.slot_prefill_shared(
+                        self.model_id, state, req.prompt, req.temperature,
+                        req.top_k, seed, plan,
+                    )
+                    hit = kind != "miss"
+                else:
+                    tok, pk, pv, hit = rt.slot_prefill(
+                        self.model_id, req.prompt, req.temperature,
+                        req.top_k, seed=seed,
+                    )
+                    last = None
             except BaseException as e:  # noqa: BLE001
                 # the req is already out of `pending` and not yet in `lanes`
                 # — without this the _loop doom sweep would miss it and its
@@ -834,6 +882,15 @@ class _ContinuousScheduler:
             eng.admitted += 1
             admitted_any = True
             admitted_n += 1
+            if hit:
+                prefix_hits_n += 1
+                if eng.metrics is not None:
+                    # exact = radix full-skip (zero prefill compute);
+                    # shared = radix partial hit AND legacy dense-cache
+                    # reuse (both paid only a suffix prefill)
+                    eng.metrics.gen_prefix_hits.labels(
+                        "continuous", "exact" if kind == "exact" else "shared"
+                    ).inc()
             if eng.metrics is not None:
                 eng.metrics.gen_admission_wait.labels("continuous").observe(
                     max(0.0, now - req.enqueue_t)
@@ -847,7 +904,25 @@ class _ContinuousScheduler:
                 retired_n += 1
                 continue
             idx = free.pop()
-            rt.slot_admit(state, idx, pk, pv)
+            if pk is None:
+                # exact shared-prefix hit: the prompt's K/V already lives in
+                # the mapped pages — nothing to insert. Its first decode
+                # write (pos = p) lands mid-way into the SHARED boundary
+                # page, so that one page is CoW'd now, while the headroom
+                # page reserved for it is guaranteed free (same scheduler
+                # turn, nothing ran in between).
+                if plan is not None and plan.tail_len > 0:
+                    rt.slot_cow(state, idx, plan.n_full)
+            elif plan is not None and kind == "shared":
+                # suffix-only insert: rows below the shared boundary stay in
+                # the read-only mapped pages, the jit redirects them to trash
+                rt.slot_admit(state, idx, pk, pv, base_tokens=plan.covered)
+            else:
+                rt.slot_admit(state, idx, pk, pv)
+            if share and pk is not None:
+                # publish this lane's prompt pages so later same-prefix
+                # admissions share them (exact hits are already indexed)
+                rt.shared_prefix_publish(state, idx, req.prompt, last)
             state.tok[idx] = int(tok)
             state.pos[idx] = p
             state.active[idx] = True
@@ -863,7 +938,10 @@ class _ContinuousScheduler:
             if admitted_n or retired_n:
                 # prefill-only boundary (every admitted row finished at its
                 # first token): still a ring entry, with no chunk dispatched
-                self._record_step(state, 0, 0, admitted_n, retired_n, 0, step_t0)
+                self._record_step(
+                    state, 0, 0, admitted_n, retired_n, 0, step_t0,
+                    prefix_hits_n,
+                )
             return state
         # chunk clamped to the pow2 cover of the largest remaining budget:
         # when every active row needs < chunk_tokens more, a smaller
@@ -873,6 +951,22 @@ class _ContinuousScheduler:
         )
         chunk = max(1, min(eng.chunk_tokens, _next_bucket(max_remaining)))
         active_rows = sum(l is not None for l in lanes)
+        if getattr(state, "paged", False) and \
+                getattr(state, "page_refs", None) is not None:
+            # copy-on-write safety net: no lane may write into a page it
+            # doesn't solely own. Admission already CoW'd the only shareable
+            # write target (the exact-hit boundary page) and a chunk only
+            # advances into the lane's own private reservation, so this
+            # never fires in the designed protocol — it is the refcount
+            # invariant's last line of defense, not a fast path.
+            for cidx, creq in enumerate(lanes):
+                if creq is None:
+                    continue
+                slot = int(state.pos[cidx]) // state.page_tokens
+                if slot < state.pages_per_slot:
+                    pg = int(state.block_tables[cidx, slot])
+                    if pg and int(state.page_refs[pg]) > 1:
+                        rt.slot_cow(state, cidx, slot)
         toks = rt.slot_decode_chunk(state, chunk)
         eng.chunks += 1
         now = time.monotonic()
@@ -902,12 +996,14 @@ class _ContinuousScheduler:
         eng._set_active(self.model_id, sum(l is not None for l in lanes))
         self._update_page_gauge(state)
         self._record_step(
-            state, chunk, active_rows, admitted_n, retired_n, wasted, step_t0
+            state, chunk, active_rows, admitted_n, retired_n, wasted, step_t0,
+            prefix_hits_n,
         )
         return state
 
     def _record_step(
-        self, state, chunk, active, admitted, retired, wasted, step_t0
+        self, state, chunk, active, admitted, retired, wasted, step_t0,
+        prefix_hits=0,
     ) -> None:
         """One flight-recorder ring entry per chunk boundary, plus the
         oldest-queued-age gauge (`gen_admission_wait` only observes at
@@ -926,6 +1022,9 @@ class _ContinuousScheduler:
                 wait_ms / 1e3
             )
         paged = state is not None and getattr(state, "paged", False)
+        shared = 0
+        if paged and hasattr(state, "page_stats"):
+            shared = state.page_stats()["shared"]
         RECORDER.record(
             str(self.model_id), "continuous",
             step_ms=(time.monotonic() - step_t0) * 1e3,
@@ -935,6 +1034,7 @@ class _ContinuousScheduler:
             ),
             pages_free=len(state.free_pages) if paged else 0,
             wasted=wasted, queue_depth=depth, oldest_wait_ms=wait_ms,
+            pages_shared=shared, prefix_hits=prefix_hits,
         )
 
     def _retire_pages(self, state, idx: int, req: _ContinuousReq) -> None:
@@ -951,10 +1051,18 @@ class _ContinuousScheduler:
 
     def _update_page_gauge(self, state) -> None:
         if state is not None and getattr(state, "paged", False):
+            if hasattr(state, "page_stats"):
+                # DISTINCT pages only: a prefix page mapped by N lanes
+                # counts once, and index-only ("cached") pages are excluded
+                # — they are reclaimable on demand, so counting them would
+                # under-report admission headroom (NodeStatus routes on it)
+                ps = state.page_stats()
+                used, shared = ps["shared"] + ps["private"], ps["shared"]
+            else:
+                used = state.arena_pages - len(state.free_pages)
+                shared = 0
             self.engine._set_pages(
-                self.model_id,
-                state.arena_pages - len(state.free_pages),
-                state.arena_pages,
+                self.model_id, used, state.arena_pages, shared
             )
 
 
@@ -988,6 +1096,7 @@ class ContinuousGenerateEngine:
         metrics=None,
         page_tokens: int | None = None,
         arena_pages: int | None = None,
+        share_prefix_bytes: int | None = None,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
@@ -995,14 +1104,20 @@ class ContinuousGenerateEngine:
         self.wait_timeout_s = wait_timeout_s
         self.metrics = metrics
         # paged-KV knobs forwarded to slot_decode_state: None = defer to the
-        # runtime's ServingConfig (kv_page_tokens / kv_arena_pages), 0 =
-        # explicit dense, > 0 = paged with this page size / arena size
+        # runtime's ServingConfig (kv_page_tokens / kv_arena_pages /
+        # kv_share_prefix_bytes), 0 = explicit dense / sharing off, > 0 =
+        # paged with this page size / arena size / prefix-index byte budget
         self.page_tokens = None if page_tokens is None else int(page_tokens)
         self.arena_pages = None if arena_pages is None else int(arena_pages)
+        self.share_prefix_bytes = (
+            None if share_prefix_bytes is None else int(share_prefix_bytes)
+        )
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}
         self._active: dict[ModelId, int] = {}
-        self._pages: dict[ModelId, tuple[int, int]] = {}  # mid -> (used, total)
+        # mid -> (used, total, shared); used counts DISTINCT pages and
+        # excludes index-only cached pages (true admission headroom)
+        self._pages: dict[ModelId, tuple[int, int, int]] = {}
         self._closed = False
         # observability (tests + bench)
         self.admitted = 0
@@ -1025,19 +1140,22 @@ class ContinuousGenerateEngine:
             value = n if self.metrics.model_labels else total
             self.metrics.gen_slots_active.labels(label).set(value)
 
-    def _set_pages(self, model_id: ModelId, used: int, total: int) -> None:
+    def _set_pages(self, model_id: ModelId, used: int, total: int,
+                   shared: int = 0) -> None:
         with self._lock:
             if total:
-                self._pages[model_id] = (used, total)
+                self._pages[model_id] = (used, total, shared)
             else:
                 self._pages.pop(model_id, None)
-            used_sum = sum(u for u, _ in self._pages.values())
-            total_sum = sum(t for _, t in self._pages.values())
+            used_sum = sum(u for u, _, _ in self._pages.values())
+            total_sum = sum(t for _, t, _ in self._pages.values())
+            shared_sum = sum(s for _, _, s in self._pages.values())
         peak = RECORDER.observe_watermark("gen_kv_pages_used", float(used_sum))
         if self.metrics is not None:
             self.metrics.gen_kv_pages_used.set(used_sum)
             self.metrics.gen_kv_pages_total.set(total_sum)
             self.metrics.gen_kv_pages_used_peak.set(peak)
+            self.metrics.gen_kv_pages_shared.set(shared_sum)
 
     def _sched(self, model_id: ModelId) -> _ContinuousScheduler:
         with self._lock:

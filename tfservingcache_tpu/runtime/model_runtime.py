@@ -499,6 +499,15 @@ class SlotDecodeState:
     block_tables: np.ndarray | None = None   # (S, pages_per_slot) i32
     free_pages: list = field(default_factory=list)
     lane_pages: dict = field(default_factory=dict)  # lane -> [page ids]
+    # -- cross-request shared-prefix KV (ISSUE 9): page_refs[pg] counts every
+    # owner of a page — referencing lanes plus the prefix index's own holds.
+    # A page is writable by a lane iff its refcount is exactly 1 (the lane is
+    # the sole owner); a first write into a refs>1 page goes through CoW
+    # (cow_page + generation._page_copy_jit). Invariant, checked by
+    # check_page_conservation: every arena page is exactly one of free,
+    # trash (page 0), or refs > 0.
+    page_refs: np.ndarray | None = None      # (arena_pages + 1,) i32
+    prefix_index: Any = None                 # PagePrefixIndex | None
 
     @property
     def paged(self) -> bool:
@@ -511,29 +520,127 @@ class SlotDecodeState:
         """Token capacity currently reserved for ``lane`` (page-granular)."""
         return len(self.lane_pages.get(lane, ())) * self.page_tokens
 
-    def reserve_pages(self, lane: int, tokens: int) -> bool:
+    def reserve_pages(self, lane: int, tokens: int,
+                      shared_pages: list | tuple = (),
+                      cow_headroom: int = 0) -> bool:
         """Reserve enough pages for ``tokens`` (the row's full prompt +
         max_new budget, so a mid-decode row can never starve) and point the
-        lane's block table at them. False when the free-list can't cover
-        it — the caller blocks admission and retries after retirements."""
+        lane's block table at them. ``shared_pages`` are already-resident
+        prefix pages mapped READ-ONLY at the front of the row (refcount
+        bump, no allocation — this is what multiplies admitted slots); only
+        the private remainder is popped from the free-list, plus
+        ``cow_headroom`` pages that must EXIST free but are left unpopped
+        for an immediately-following slot_cow. False when the free-list
+        can't cover it — the caller blocks admission and retries after
+        retirements."""
         need = self.pages_needed(tokens)
-        if need > len(self.free_pages):
+        n_map = len(shared_pages)
+        priv = max(0, need - n_map)
+        if priv + cow_headroom > len(self.free_pages):
             return False
-        pages = [self.free_pages.pop() for _ in range(need)]
+        pages = [int(pg) for pg in shared_pages]
+        pages += [self.free_pages.pop() for _ in range(priv)]
+        if self.page_refs is not None:
+            for pg in pages:
+                self.page_refs[pg] += 1
         self.lane_pages[lane] = pages
         self.block_tables[lane, :] = 0
-        self.block_tables[lane, :need] = pages
+        self.block_tables[lane, :len(pages)] = pages
         return True
 
     def release_pages(self, lane: int) -> None:
-        """Recycle a retired/failed lane's pages and park the lane on the
-        trash page (zeroed table row) so its frozen in-chunk rewrites can
-        never touch a recycled page's next occupant."""
+        """Drop a retired/failed lane's page references — a page returns to
+        the free-list only when its LAST owner lets go (shared prefix pages
+        survive for their other referencing lanes / the prefix index) — and
+        park the lane on the trash page (zeroed table row) so its frozen
+        in-chunk rewrites can never touch a recycled page's next occupant."""
         pages = self.lane_pages.pop(lane, None)
         if pages:
-            self.free_pages.extend(pages)
+            if self.page_refs is None:
+                self.free_pages.extend(pages)
+            else:
+                for pg in pages:
+                    n = int(self.page_refs[pg]) - 1
+                    self.page_refs[pg] = max(n, 0)
+                    if n <= 0:
+                        self.free_pages.append(pg)
         if self.block_tables is not None:
             self.block_tables[lane, :] = 0
+
+    def cow_page(self, lane: int, slot: int) -> tuple[int, int] | None:
+        """Host half of copy-on-write: swap ``lane``'s block-table entry at
+        ``slot`` to a fresh free page and move the lane's reference onto it.
+        Returns (src, dst) for the device page copy, or None when the
+        free-list is empty (callers reserve cow_headroom so the admission
+        protocol can't hit that)."""
+        if not self.free_pages:
+            return None
+        src = int(self.block_tables[lane, slot])
+        dst = self.free_pages.pop()
+        self.page_refs[dst] = 1
+        self.block_tables[lane, slot] = dst
+        self.lane_pages[lane][slot] = dst
+        n = int(self.page_refs[src]) - 1
+        self.page_refs[src] = max(n, 0)
+        if n <= 0:
+            # the "shared" page was sole-owned after all (caller raced its
+            # own check) — recycle rather than leak it
+            self.free_pages.append(src)
+        return src, dst
+
+    def page_stats(self) -> dict:
+        """Distinct-page split of the arena (trash page 0 excluded):
+        ``free`` on the free-list, ``cached`` held only by the prefix index
+        (reclaimable under admission pressure), ``shared`` referenced by a
+        lane AND at least one other owner, ``private`` sole-owned by one
+        lane. Used by the flight recorder / gauges; a shared page counts
+        ONCE no matter how many lanes read it, so pages_used reflects true
+        admission headroom."""
+        lane_refs: dict[int, int] = {}
+        for pages in self.lane_pages.values():
+            for pg in pages:
+                lane_refs[pg] = lane_refs.get(pg, 0) + 1
+        held = (self.prefix_index.held_pages()
+                if self.prefix_index is not None else {})
+        shared = sum(1 for pg, n in lane_refs.items()
+                     if n > 1 or pg in held)
+        return {
+            "free": len(self.free_pages),
+            "cached": sum(1 for pg in held if pg not in lane_refs),
+            "shared": shared,
+            "private": len(lane_refs) - shared,
+        }
+
+    def check_page_conservation(self) -> None:
+        """Assert the refcount invariant over the whole arena: every usable
+        page is exactly one of free, or referenced, with ``page_refs``
+        agreeing with the actual lane + index reference census — i.e. no
+        page is leaked and none is double-booked. Test/bench hook (cheap:
+        O(arena), host-only)."""
+        if not self.paged:
+            return
+        census = np.zeros(self.arena_pages + 1, np.int64)
+        for pages in self.lane_pages.values():
+            for pg in pages:
+                census[pg] += 1
+        if self.prefix_index is not None:
+            for pg, n in self.prefix_index.held_pages().items():
+                census[pg] += n
+        free = set(self.free_pages)
+        assert len(free) == len(self.free_pages), "duplicate free-list pages"
+        assert 0 not in free, "trash page on the free list"
+        assert census[0] == 0, "trash page is referenced"
+        for pg in range(1, self.arena_pages + 1):
+            refs = int(census[pg])
+            if pg in free:
+                assert refs == 0, f"page {pg} free but referenced {refs}x"
+            else:
+                assert refs > 0, f"page {pg} leaked (not free, unreferenced)"
+            if self.page_refs is not None:
+                got = int(self.page_refs[pg])
+                assert got == refs, (
+                    f"page {pg}: page_refs says {got}, census says {refs}"
+                )
 
 
 class TPUModelRuntime(BaseRuntime):
@@ -1515,6 +1622,7 @@ class TPUModelRuntime(BaseRuntime):
         slots: int,
         page_tokens: int | None = None,
         arena_pages: int | None = None,
+        share_prefix_bytes: int | None = None,
     ) -> SlotDecodeState:
         """Create-or-get the model's slot state. One compiled decode-chunk
         program serves all ``slots`` lanes. ``page_tokens`` / ``arena_pages``
@@ -1552,7 +1660,8 @@ class TPUModelRuntime(BaseRuntime):
             if st is not None:
                 return st  # the racer that held the guard built it
             st = self._build_slot_state(
-                loaded, model_id, slots, page_tokens, arena_pages
+                loaded, model_id, slots, page_tokens, arena_pages,
+                share_prefix_bytes,
             )
             with self._slot_lock:
                 st = self._slot_states.setdefault(model_id, st)
@@ -1566,6 +1675,7 @@ class TPUModelRuntime(BaseRuntime):
         slots: int,
         page_tokens: int | None,
         arena_pages: int | None,
+        share_prefix_bytes: int | None = None,
     ) -> SlotDecodeState:
         from tfservingcache_tpu.models.generation import (
             init_cache,
@@ -1576,6 +1686,10 @@ class TPUModelRuntime(BaseRuntime):
             page_tokens = int(getattr(self.cfg, "kv_page_tokens", 0))
         if arena_pages is None:
             arena_pages = int(getattr(self.cfg, "kv_arena_pages", 0))
+        if share_prefix_bytes is None:
+            share_prefix_bytes = int(
+                getattr(self.cfg, "kv_share_prefix_bytes", 0)
+            )
         cfg = loaded.model_def.config
         max_seq = int(cfg["max_seq"])
         common = dict(
@@ -1596,6 +1710,18 @@ class TPUModelRuntime(BaseRuntime):
             usable = int(arena_pages) if arena_pages else slots * pps
             # +1: page 0 is the trash page, permanently reserved
             cache = init_paged_cache(cfg, usable + 1, page_tokens)
+            prefix_index = None
+            if share_prefix_bytes and share_prefix_bytes > 0:
+                from tfservingcache_tpu.runtime.prefix_cache import (
+                    PagePrefixIndex,
+                )
+
+                page_nbytes = (
+                    int(cache["k"].nbytes) + int(cache["v"].nbytes)
+                ) // (usable + 1)
+                prefix_index = PagePrefixIndex(
+                    page_tokens, page_nbytes, int(share_prefix_bytes)
+                )
             return SlotDecodeState(
                 k=cache["k"],
                 v=cache["v"],
@@ -1604,6 +1730,8 @@ class TPUModelRuntime(BaseRuntime):
                 pages_per_slot=pps,
                 block_tables=np.zeros((slots, pps), np.int32),
                 free_pages=list(range(1, usable + 1)),
+                page_refs=np.zeros((usable + 1,), np.int32),
+                prefix_index=prefix_index,
                 **common,
             )
         cache = init_cache(cfg, slots, max_seq)
@@ -1627,6 +1755,23 @@ class TPUModelRuntime(BaseRuntime):
         its completions live in the slot array, not in cache entries) and
         sample the request's first token. -> (first_token, k, v, prefix_hit)
         with k/v ready for ``slot_admit``."""
+        tok, pk, pv, hit, _last = self._slot_prefill_impl(
+            model_id, prompt, temperature, top_k, seed
+        )
+        return tok, pk, pv, hit
+
+    def _slot_prefill_impl(
+        self,
+        model_id: ModelId,
+        prompt: np.ndarray,
+        temperature: float,
+        top_k: int,
+        seed: int,
+    ) -> tuple[int, Any, Any, bool, Any]:
+        """slot_prefill body, also returning the last-position logits (the
+        5th element, a (1, V) f32 device array) — the shared-prefix
+        publisher caches them so an exact re-admission can sample its first
+        token without re-running the prefill."""
         import jax
 
         from tfservingcache_tpu.models.generation import (
@@ -1659,7 +1804,7 @@ class TPUModelRuntime(BaseRuntime):
         if hit is not None:
             ids = prompt[None, :]
             suffix, suffix_len = self._prefix_suffix(ids, p, hit)
-            tok, pk, pv = _slot_prefill_from_cache_jit(
+            tok, pk, pv, last = _slot_prefill_from_cache_jit(
                 loaded.params, suffix,
                 np.asarray([suffix_len], np.int32),
                 hit.k, hit.v, np.asarray([hit.valid_len], np.int32),
@@ -1672,19 +1817,216 @@ class TPUModelRuntime(BaseRuntime):
                 s_pad = p  # bucket overshoot: exact size (same rule as generate)
             ids = np.zeros((1, s_pad), np.int32)
             ids[0, :p] = prompt
-            tok, pk, pv = _slot_prefill_jit(
+            tok, pk, pv, last = _slot_prefill_jit(
                 loaded.params, ids, np.asarray([p], np.int32),
                 rng, temp, tk, cfg_key=cfg_key,
                 family=loaded.model_def.family,
             )
-        return int(np.asarray(tok)[0]), pk, pv, hit is not None
+        return int(np.asarray(tok)[0]), pk, pv, hit is not None, last
 
-    def slot_admit(self, state: SlotDecodeState, idx: int, pk: Any, pv: Any) -> None:
+    # -- shared-prefix KV over the paged arena (ISSUE 9) ---------------------
+    def shared_prefix_plan(
+        self,
+        state: SlotDecodeState,
+        prompt: np.ndarray,
+    ) -> Any:
+        """Longest viable page-aligned shared prefix for ``prompt`` from the
+        state's radix index (None when sharing is off or nothing matches).
+        Viability trim: the suffix prefill pads to a pow2 bucket, and
+        cached_len + bucket must fit the lane — when it doesn't, shed
+        mapped pages (each shed moves ``page_tokens`` tokens back into the
+        suffix) until it does, mirroring the dense hit's overflow rule."""
+        idx = getattr(state, "prefix_index", None)
+        if idx is None:
+            return None
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        plan = idx.lookup(prompt)
+        if plan is None:
+            return None
+        if plan.kind == "exact":
+            return plan
+        while plan.n_full > 0 and \
+                plan.covered + next_bucket(p - plan.covered) > state.max_seq:
+            plan.pages.pop()
+            plan.n_full -= 1
+        if plan.n_full == 0:
+            return None
+        return plan
+
+    def slot_prefill_shared(
+        self,
+        model_id: ModelId,
+        state: SlotDecodeState,
+        prompt: np.ndarray,
+        temperature: float,
+        top_k: int,
+        seed: int,
+        plan: Any,
+    ) -> tuple[int, Any, Any, str, Any]:
+        """Admission prefill with shared-prefix reuse ->
+        (first_token, pk, pv, kind, last_logits).
+
+        ``plan.kind == "exact"``: zero prefill compute — the first token is
+        sampled from the publisher's cached last-position logits under THIS
+        request's seed (the same split-then-sample the prefill jits do, so
+        it is byte-identical to a cold prefill of the same prompt);
+        pk/pv are None and the caller skips slot_admit. ``"shared"``: gather
+        the mapped full pages to dense rows and prefill only the suffix
+        (kind stays "shared"). ``plan is None``: full/dense-cache path via
+        _slot_prefill_impl; kind is "dense" on a legacy dense-cache hit,
+        "miss" otherwise."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import (
+            _paged_gather_prefix_jit,
+            _sample_logits_jit,
+            _slot_prefill_from_cache_jit,
+        )
+
+        if plan is None:
+            tok, pk, pv, hit, last = self._slot_prefill_impl(
+                model_id, prompt, temperature, top_k, seed
+            )
+            return tok, pk, pv, ("dense" if hit else "miss"), last
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        rng = jax.random.PRNGKey(seed)
+        temp = np.float32(temperature)
+        tk = np.int32(top_k)
+        if plan.kind == "exact":
+            tok = _sample_logits_jit(
+                np.asarray(plan.logits, np.float32), rng, temp, tk
+            )
+            return int(np.asarray(tok)[0]), None, None, "exact", plan.logits
+        cfg = loaded.model_def.config
+        cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
+        covered = plan.covered
+        ck, cv = _paged_gather_prefix_jit(
+            state.k, state.v, np.asarray(plan.pages, np.int32)
+        )
+        suffix_len = p - covered
+        s_pad = next_bucket(suffix_len)
+        suffix = np.zeros((1, s_pad), np.int32)
+        suffix[0, :suffix_len] = prompt[covered:]
+        tok, pk, pv, last = _slot_prefill_from_cache_jit(
+            loaded.params, suffix,
+            np.asarray([suffix_len], np.int32),
+            ck, cv, np.asarray([covered], np.int32),
+            rng, temp, tk, cfg_key=cfg_key,
+            family=loaded.model_def.family,
+        )
+        return int(np.asarray(tok)[0]), pk, pv, "shared", last
+
+    def slot_cow(self, state: SlotDecodeState, lane: int, slot: int) -> None:
+        """Copy-on-write: give ``lane`` a private copy of the page behind
+        its block-table ``slot`` before its first write lands there. The
+        page copy + host table swap are data (one compiled program total),
+        never a new decode-chunk signature. Raises when no free page exists
+        — the admission protocol reserves cow_headroom precisely so this
+        cannot happen."""
+        from tfservingcache_tpu.models.generation import _page_copy_jit
+
+        swap = state.cow_page(lane, slot)
+        if swap is None:
+            raise RuntimeError_(
+                f"CoW for lane {lane} slot {slot}: free-list empty "
+                "(cow_headroom was not reserved?)"
+            )
+        src, dst = swap
+        state.k, state.v = _page_copy_jit(
+            state.k, state.v, np.int32(src), np.int32(dst)
+        )
+
+    def shared_prefix_publish(
+        self,
+        state: SlotDecodeState,
+        lane: int,
+        prompt: np.ndarray,
+        last_logits: Any,
+    ) -> None:
+        """After admitting ``lane``, publish its prompt's pages into the
+        radix index so later same-prefix admissions can share them. Full
+        page chunks are indexed IN PLACE (the index just increfs the lane's
+        own pages — the lane only ever writes past the prompt). A partially
+        filled boundary page is EAGER-COPIED into a fresh free page for the
+        index (the lane keeps decoding into its original), so the indexed
+        copy stays pristine — tail tokens + zeros — and neither side ever
+        needs CoW against the other. Skipped silently when nothing
+        page-aligned is shareable or no free page exists for the copy."""
+        idx = getattr(state, "prefix_index", None)
+        if idx is None:
+            return
+        from tfservingcache_tpu.models.generation import _page_copy_jit
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        pt = state.page_tokens
+        n_full = p // pt
+        tail_len = p - n_full * pt
+        lane_pg = state.lane_pages.get(lane)
+        if lane_pg is None or len(lane_pg) < state.pages_needed(p):
+            return
+        if last_logits is not None:
+            last_logits = np.asarray(last_logits, np.float32)
+        boundary = None
+        if tail_len and last_logits is not None and state.free_pages:
+            src = lane_pg[n_full]
+            boundary = state.free_pages.pop()
+            state.k, state.v = _page_copy_jit(
+                state.k, state.v, np.int32(src), np.int32(boundary)
+            )
+        added, released = idx.insert(
+            prompt, lane_pg[:n_full], boundary, last_logits, state.page_refs
+        )
+        for pg in added:
+            state.page_refs[pg] += 1
+        for pg in released:
+            n = int(state.page_refs[pg]) - 1
+            state.page_refs[pg] = max(n, 0)
+            if n <= 0:
+                state.free_pages.append(pg)
+        if boundary is not None and boundary not in added:
+            state.free_pages.append(boundary)  # index declined the tail
+
+    def reclaim_prefix_pages(
+        self,
+        state: SlotDecodeState,
+        want_pages: int,
+        protect: list | tuple = (),
+    ) -> int:
+        """Admission pressure valve: evict cold index-only prefix pages
+        (zero lane refs, skipping ``protect`` — the blocked request's own
+        plan pages) back onto the free-list so a live admission never loses
+        a page fight to cold cache. Returns how many pages were freed."""
+        idx = getattr(state, "prefix_index", None)
+        if idx is None:
+            return 0
+        released = idx.reclaim(
+            state.page_refs, want_pages, frozenset(int(p) for p in protect)
+        )
+        freed = 0
+        for pg in released:
+            n = int(state.page_refs[pg]) - 1
+            state.page_refs[pg] = max(n, 0)
+            if n <= 0:
+                state.free_pages.append(pg)
+                freed += 1
+        return freed
+
+    def slot_admit(self, state: SlotDecodeState, idx: int, pk: Any, pv: Any,
+                   base_tokens: int = 0) -> None:
         """Copy an admitted request's prefill K/V into slot lane ``idx``
         (in-place via donation). The caller (scheduler thread) owns the host
         mirrors and sets tok/pos/active/temps/topks itself; for a paged
         state it must have reserved the lane's pages (reserve_pages) first —
-        the insert scatters through the lane's block-table row."""
+        the insert scatters through the lane's block-table row.
+        ``base_tokens`` is the shared-prefix boundary: prefill rows below it
+        belong to read-only shared pages and are redirected to the trash
+        page (the suffix prefill only produced junk there anyway)."""
         from tfservingcache_tpu.models.generation import (
             _paged_insert_jit,
             _slot_insert_jit,
@@ -1694,6 +2036,7 @@ class TPUModelRuntime(BaseRuntime):
             state.k, state.v = _paged_insert_jit(
                 state.k, state.v, pk, pv,
                 np.asarray(state.block_tables[idx], np.int32),
+                np.int32(base_tokens),
                 page_tokens=state.page_tokens,
             )
             return

@@ -174,6 +174,7 @@ class CacheNode:
                 generate_chunk_tokens=cfg.serving.generate_chunk_tokens,
                 kv_page_tokens=cfg.serving.kv_page_tokens,
                 kv_arena_pages=cfg.serving.kv_arena_pages,
+                kv_share_prefix_bytes=cfg.serving.kv_share_prefix_bytes,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first

@@ -67,6 +67,10 @@ STEP_FIELDS = (
     "wasted",          # steps computed for already-finished rows this step
     "queue_depth",     # rows still waiting for admission
     "oldest_wait_ms",  # age of the oldest queued row (0 when queue empty)
+    # appended fields (ISSUE 9 shared-prefix KV) — new names go at the END
+    # so the positional indices older dumps/tools rely on stay valid
+    "pages_shared",    # arena pages referenced by >1 owner after this step
+    "prefix_hits",     # admissions this boundary that reused prefix KV
 )
 
 DEFAULT_RING_ENTRIES = 4096
@@ -182,11 +186,13 @@ class FlightRecorder:
         wasted: int = 0,
         queue_depth: int = 0,
         oldest_wait_ms: float = 0.0,
+        pages_shared: int = 0,
+        prefix_hits: int = 0,
     ) -> None:
         self._ring(model).append((
             time.time(), engine, round(step_ms, 4), chunk, active, admitted,
             retired, pages_used, pages_free, wasted, queue_depth,
-            round(oldest_wait_ms, 3),
+            round(oldest_wait_ms, 3), pages_shared, prefix_hits,
         ))
 
     def note_phases(
@@ -236,6 +242,10 @@ class FlightRecorder:
         to "is the engine's compute going to live requests"."""
         total = sum(e[4] * e[3] for e in entries)       # active * chunk
         wasted = sum(e[9] for e in entries)
+        admitted = sum(e[5] for e in entries)
+        # appended fields may be absent in entries deserialized from old
+        # dumps — treat short tuples as zero, same as a dense engine
+        hits = sum(e[13] for e in entries if len(e) > 13)
         return {
             "steps": len(entries),
             "step_slots": total,
@@ -244,6 +254,12 @@ class FlightRecorder:
             "step_ms_sum": round(sum(e[2] for e in entries), 3),
             "max_queue_depth": max((e[10] for e in entries), default=0),
             "max_oldest_wait_ms": max((e[11] for e in entries), default=0.0),
+            "admitted": admitted,
+            "prefix_hits": hits,
+            "prefix_hit_rate": round(hits / admitted, 6) if admitted else 0.0,
+            "max_pages_shared": max(
+                (e[12] for e in entries if len(e) > 12), default=0
+            ),
         }
 
     def engine_stats(self, tail: int = 32) -> dict[str, float]:

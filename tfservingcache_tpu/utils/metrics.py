@@ -240,6 +240,24 @@ class Metrics:
             "/monitoring/engine scrape (reset-on-scrape)",
             registry=r,
         )
+        self.gen_kv_pages_shared = Gauge(
+            "tpusc_gen_kv_pages_shared",
+            "KV arena pages currently referenced by MORE than one owner "
+            "(shared-prefix pages mapped read-only into multiple lanes' "
+            "block tables and/or held by the radix prefix index); each "
+            "counted once — gen_kv_pages_used minus this is the private "
+            "page population",
+            registry=r,
+        )
+        self.gen_prefix_hits = Counter(
+            "tpusc_gen_prefix_hits",
+            "Continuous-engine admissions that reused prompt-prefix KV: "
+            "kind=exact skipped prefill entirely (radix index full match, "
+            "first token sampled from cached logits), kind=shared paid "
+            "only a suffix prefill (radix partial match or dense "
+            "prefix-cache reuse)",
+            ["engine", "kind"], registry=r,
+        )
         self.gen_kv_page_waste = Histogram(
             "tpusc_gen_kv_page_waste_tokens",
             "Per retired row: reserved page capacity minus tokens that "

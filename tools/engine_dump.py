@@ -52,12 +52,20 @@ def fetch(url: str, steps: int, model: str | None = None, timeout: float = 5.0) 
 
 
 def _fmt_step(s: dict) -> str:
+    used = s.get("pages_used", 0)
+    shared = s.get("pages_shared", 0)
+    # shared/private/free page split: `used` is physical occupancy
+    # (arena - free), shared of those are multi-owner prefix pages
+    pages = (
+        f"pages={shared}s+{max(0, used - shared)}p"
+        f"/{s.get('pages_free', 0)}f"
+    )
     return (
         f"  {s.get('engine', '?'):<10} step={s.get('step_ms', 0):>8.2f}ms "
         f"chunk={s.get('chunk', 0):>3} active={s.get('active', 0):>3} "
         f"+{s.get('admitted', 0)}/-{s.get('retired', 0)} "
         f"wasted={s.get('wasted', 0):>3} "
-        f"pages={s.get('pages_used', 0)}/{s.get('pages_used', 0) + s.get('pages_free', 0)} "
+        f"{pages} "
         f"queue={s.get('queue_depth', 0):>3} "
         f"oldest={s.get('oldest_wait_ms', 0):>8.1f}ms"
     )
@@ -110,6 +118,13 @@ def render(dump: dict, max_steps: int = 32, out=sys.stdout) -> None:
             f"max queue={win.get('max_queue_depth', 0)}, "
             f"max wait={win.get('max_oldest_wait_ms', 0.0):.1f}ms\n"
         )
+        if win.get("admitted"):
+            w(
+                f"prefix sharing: {win.get('prefix_hits', 0)}"
+                f"/{win['admitted']} admissions hit "
+                f"(rate={win.get('prefix_hit_rate', 0.0):.3f}), "
+                f"max shared pages={win.get('max_pages_shared', 0)}\n"
+            )
         spans = _stall_spans(steps)
         if spans:
             w("stall spans (steps with a non-empty admission queue):\n")
