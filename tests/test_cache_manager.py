@@ -197,3 +197,37 @@ def test_health(setup, tmp_path):
     assert manager.is_healthy()
     manager.provider = DiskModelProvider(str(tmp_path / "missing"))
     assert not manager.is_healthy()
+
+
+def test_deadline_workers_tracked_capped_and_joined(setup):
+    """Cold-load deadline workers are no longer fire-and-forget: each one is
+    registered in ``_load_workers``, a deadline storm hits the cap instead of
+    piling up unbounded daemon threads, and close() joins stragglers so
+    shutdown doesn't race their landing writes."""
+    import time
+
+    from tfservingcache_tpu.cache.manager import LoadTimeoutError
+
+    manager, runtime, cache = setup
+    manager.load_timeout_s = 0.05  # deadlines only exist when this is set
+    release = threading.Event()
+
+    def stuck():
+        release.wait(10.0)
+        return "landed"
+
+    # a request that times out leaves its worker registered until it finishes
+    with pytest.raises(LoadTimeoutError):
+        manager._with_deadline(stuck, time.monotonic() + 0.05, "t1")
+    assert len(manager._load_workers) == 1
+
+    # deadline storm: the cap fails fast instead of spawning another thread
+    manager.max_load_workers = 1
+    with pytest.raises(LoadTimeoutError, match="deadline storm"):
+        manager._with_deadline(stuck, time.monotonic() + 0.05, "t2")
+    assert len(manager._load_workers) == 1
+
+    # finished workers deregister themselves; close() joins any stragglers
+    release.set()
+    manager.close()
+    assert len(manager._load_workers) == 0

@@ -7,7 +7,6 @@ import json
 from contextlib import asynccontextmanager
 
 import aiohttp
-import grpc
 
 from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.manager import CacheManager

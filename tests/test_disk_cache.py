@@ -99,3 +99,21 @@ def test_replace_put_does_not_delete_new_artifact(tmp_path):
     got = cache.get(mid)
     assert got is not None and os.path.exists(got.path)
     assert cache.total_bytes == 20
+
+
+def test_key_locks_pruned_after_missed_fetch(tmp_path):
+    """A fetch that never lands (bad name, provider error) must not leave a
+    permanent ``_key_locks`` entry: never cached means the evict-side prune
+    never runs for it, so a storm of misses on bad names would otherwise grow
+    the dict without bound."""
+    cache = ModelDiskCache(str(tmp_path), capacity_bytes=1000)
+    ghost = ModelId("ghost", 1)
+    with cache.fetch_lock(ghost):
+        assert ghost in cache._key_locks  # live while the fetch is in flight
+    assert ghost not in cache._key_locks  # pruned: idle and non-resident
+
+    # a fetch that DOES land keeps its lock for the eviction handshake
+    mid = ModelId("real", 1)
+    with cache.fetch_lock(mid):
+        cache.put(write_artifact(cache, mid, 10))
+    assert mid in cache._key_locks

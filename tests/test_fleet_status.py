@@ -25,7 +25,7 @@ from tfservingcache_tpu.cluster.status import (
     StatusCollector,
     StatusExchange,
 )
-from tfservingcache_tpu.protocol.grpc_server import PREDICTION_SERVICE, GrpcServingServer
+from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
 from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
 from tfservingcache_tpu.protocol.rest import RestServingServer
 from tfservingcache_tpu.protocol.protos import tf_serving_pb2 as sv

@@ -4,19 +4,17 @@ LocalServingBackend -> REST/router stack, and a two-chip-group CacheNode
 whose ring assigns tenants to groups (VERDICT.md round-1 item #2; SURVEY.md
 §7 step 8 — the hard part the training-shaped dryrun didn't cover)."""
 
-import asyncio
 
 import pytest
 
 import aiohttp
-import jax
 import numpy as np
 
 from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.manager import CacheManager
 from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
 from tfservingcache_tpu.config import Config, ServingConfig
-from tfservingcache_tpu.models.registry import build, export_artifact
+from tfservingcache_tpu.models.registry import export_artifact
 from tfservingcache_tpu.parallel.mesh import make_mesh
 from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
 from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime

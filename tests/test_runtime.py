@@ -264,3 +264,19 @@ def test_executable_shared_across_tenants_and_freed(tmp_path):
         assert key not in rt._jitted_by_key         # last tenant freed the executable
     finally:
         rt.close()
+
+
+def test_load_locks_pruned_after_failing_load(runtime, tmp_path):
+    """A model whose load keeps failing never becomes resident, so the
+    evict-side prune never fires for it — the failure path must drop the idle
+    ``_load_locks`` entry itself or a storm of failing tenants grows the dict
+    without bound (mirror of the soak's bounded-internals assertion)."""
+    bad_dir = tmp_path / "cursed" / "1"
+    bad_dir.mkdir(parents=True)
+    (bad_dir / "model.json").write_text("{not json")
+    mid = ModelId("cursed", 1)
+    model = Model(identifier=mid, path=str(bad_dir), size_on_disk=10)
+    for _ in range(3):
+        with pytest.raises(RuntimeError_):
+            runtime.ensure_loaded(model)
+        assert mid not in runtime._load_locks

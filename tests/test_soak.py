@@ -22,6 +22,7 @@ from tfservingcache_tpu.config import ServingConfig
 from tfservingcache_tpu.models.registry import export_artifact
 from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils import lockcheck
 from tfservingcache_tpu.utils.metrics import Metrics
 
 N_TENANTS = 1000
@@ -69,11 +70,13 @@ def test_thousand_tenant_churn(tenant_store, tmp_path, monkeypatch):
         # executable sharing is THE thing that makes 1000 tenants affordable:
         # all tenants share one (family, config) jit; churn must not respawn it
         assert len(jit_calls) <= 2, f"{len(jit_calls)} jax.jit calls for {N_TENANTS} tenants"
-        assert len(rt._jitted_by_key) == 1
+        with rt._jit_lock:
+            assert len(rt._jitted_by_key) == 1
         assert len(rt.resident_models()) <= RESIDENT_CAP
 
         # bounded internals after churn of 1000 through a 32-slot runtime
-        assert len(rt._load_locks) <= RESIDENT_CAP + 8, len(rt._load_locks)
+        with rt._load_locks_guard:
+            assert len(rt._load_locks) <= RESIDENT_CAP + 8, len(rt._load_locks)
 
         # zipfian warm traffic (a few hot tenants + long tail)
         rng = np.random.default_rng(0)
@@ -97,10 +100,12 @@ def test_thousand_tenant_churn(tenant_store, tmp_path, monkeypatch):
         for mid in list(rt.resident_models()):
             rt.unload(mid)
         assert rt.hbm_bytes_in_use == 0
-        assert len(rt._jitted_by_key) == 0  # last tenant gone -> executable freed
+        with rt._jit_lock:
+            assert len(rt._jitted_by_key) == 0  # last tenant gone -> executable freed
         assert metrics.hbm_bytes_in_use.labels("0")._value.get() == 0
     finally:
         mgr.close()
+    lockcheck.assert_clean()  # no-op unless TPUSC_LOCKCHECK=1
 
 
 def test_disk_tier_eviction_under_tenant_churn(tenant_store, tmp_path):
@@ -130,9 +135,11 @@ def test_disk_tier_eviction_under_tenant_churn(tenant_store, tmp_path):
         assert cache.get(victim) is None
         mgr.ensure_servable(victim)
         assert rt.is_loaded(victim)
-        assert len(cache._key_locks) <= len(cache.list_models()) + 8
+        with cache._key_locks_guard:
+            assert len(cache._key_locks) <= len(cache.list_models()) + 8
     finally:
         mgr.close()
+    lockcheck.assert_clean()
 
 
 def test_host_tier_resident_set_guard_under_churn(tenant_store, tmp_path):
@@ -185,6 +192,7 @@ def test_host_tier_resident_set_guard_under_churn(tenant_store, tmp_path):
         assert metrics.host_tier_bytes._value.get() == 0
     finally:
         mgr.close()
+    lockcheck.assert_clean()
 
 
 def test_shared_prefix_refcount_conservation_under_churn(tmp_path):
@@ -224,17 +232,20 @@ def test_shared_prefix_refcount_conservation_under_churn(tmp_path):
                 )
             out = eng.generate(mid, ids, max_new_tokens=4)
             assert out.shape == (rows, 4)
-            st = rt._slot_states[mid]
+            with rt._slot_lock:
+                st = rt._slot_states[mid]
             st.check_page_conservation()  # free XOR trash XOR referenced
             stats = st.page_stats()
             assert stats["shared"] == 0 and stats["private"] == 0
             assert stats["free"] + stats["cached"] == st.arena_pages
         assert eng.admitted == rows * waves  # 200 retirements, zero stuck
-        idx = rt._slot_states[mid].prefix_index
+        with rt._slot_lock:
+            idx = rt._slot_states[mid].prefix_index
         assert idx.hits > 0  # the swarm actually exercised sharing
     finally:
         eng.close()
         rt.close()
+    lockcheck.assert_clean()
 
 
 def test_resolve_version_negative_and_positive_cache(tmp_path):

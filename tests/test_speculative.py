@@ -3,7 +3,6 @@ property is EXACTNESS — a draft may change when tokens are computed, never
 which — plus the runtime/REST plumbing (draft resolution, solo execution,
 validation)."""
 
-import json
 
 import aiohttp
 import jax

@@ -6,7 +6,6 @@ import json
 import threading
 
 import aiohttp
-import numpy as np
 
 from tfservingcache_tpu.utils.tracing import TRACER, Tracer
 

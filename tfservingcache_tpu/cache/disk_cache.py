@@ -22,6 +22,7 @@ from typing import Callable, Iterator
 from tfservingcache_tpu.cache.lru import LRUEntry
 from tfservingcache_tpu.native import make_lru_cache
 from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 
 log = get_logger("disk_cache")
@@ -41,7 +42,11 @@ def dir_size_bytes(path: str) -> int:
     return total
 
 
+@lockchecked
 class ModelDiskCache:
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_key_locks": "_key_locks_guard"}
+
     def __init__(
         self,
         base_dir: str,
@@ -81,8 +86,19 @@ class ModelDiskCache:
         is being re-loaded waits, then sees it resident again and skips."""
         with self._key_locks_guard:
             lock = self._key_locks.setdefault(model_id, threading.Lock())
-        with lock:
-            yield
+        try:
+            with lock:
+                yield
+        finally:
+            # Failure-path pruning: a fetch that never lands (provider error,
+            # deadline) leaves a key the evict-side pruning can never reach —
+            # never cached means never evicted — so a storm of misses on bad
+            # names would grow this dict without bound. Same rule as
+            # _evict_impl: drop the entry once it is idle and non-resident.
+            with self._key_locks_guard:
+                held = self._key_locks.get(model_id)
+                if held is lock and not held.locked() and model_id not in self.lru:
+                    del self._key_locks[model_id]
 
     # -- paths --------------------------------------------------------------
     def model_path(self, model_id: ModelId) -> str:

@@ -29,6 +29,7 @@ from tfservingcache_tpu.cache.lru import LRUEntry
 from tfservingcache_tpu.native import make_lru_cache
 from tfservingcache_tpu.types import ModelId
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 
@@ -73,6 +74,7 @@ class PackedModelEntry:
     wire_hashes: list[str] | None = None
 
 
+@lockchecked
 class HostRamTier:
     """Thread-safe byte-budgeted LRU of ``PackedModelEntry``.
 
@@ -82,6 +84,12 @@ class HostRamTier:
     metrics: ``tpusc_host_tier_bytes`` gauge and
     ``tpusc_evictions_total{tier="host"}``.
     """
+
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {
+        "_pins": "_pin_lock",
+        "_pinned_evicted": "_pin_lock",
+    }
 
     def __init__(self, capacity_bytes: int, metrics: Metrics | None = None) -> None:
         self.metrics = metrics

@@ -26,6 +26,7 @@ from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.providers.base import ModelProvider
 from tfservingcache_tpu.runtime.base import BaseRuntime, LoadTimeoutError
 from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 from tfservingcache_tpu.utils.tracing import TRACER
@@ -54,7 +55,16 @@ def resolve_version_label(version_labels: dict, name: str,
         ) from None
 
 
+@lockchecked
 class CacheManager:
+    # Guarded-field registry: checked statically by tools/tpusc_check
+    # (TPUSC001) and dynamically under TPUSC_LOCKCHECK=1 (utils/lockcheck).
+    _tpusc_guarded = {
+        "_version_cache": "_version_cache_lock",
+        "_negative_cache": "_version_cache_lock",
+        "_load_workers": "_load_workers_lock",
+    }
+
     def __init__(
         self,
         provider: ModelProvider,
@@ -83,6 +93,11 @@ class CacheManager:
         self._version_cache_lock = threading.Lock()
         self.version_cache_ttl_s = 10.0
         self.negative_cache_ttl_s = 2.0
+        # Deadline workers (see _with_deadline): tracked so close() can join
+        # stragglers and a timeout storm can't pile up unbounded threads.
+        self._load_workers: set[threading.Thread] = set()
+        self._load_workers_lock = threading.Lock()
+        self.max_load_workers = 64
         # a model evicted from the disk tier must not keep serving from HBM:
         # its artifact is gone, a restart would break the invariant that
         # resident => re-loadable (subscribe, don't overwrite: several
@@ -227,8 +242,19 @@ class CacheManager:
                 box["error"] = e
             finally:
                 done.set()
+                with self._load_workers_lock:
+                    self._load_workers.discard(threading.current_thread())
 
-        threading.Thread(target=work, daemon=True, name="tpusc-load-worker").start()
+        worker = threading.Thread(target=work, daemon=True, name="tpusc-load-worker")
+        with self._load_workers_lock:
+            if len(self._load_workers) >= self.max_load_workers:
+                raise LoadTimeoutError(
+                    f"{desc}: {self.max_load_workers} cold-load workers already "
+                    "in flight (deadline storm); failing fast instead of "
+                    "spawning an unbounded thread pile"
+                )
+            self._load_workers.add(worker)
+        worker.start()
         if not done.wait(remaining):
             log.warning("%s exceeded cold-load deadline (%.1fs); request fails 504, "
                         "work continues in background", desc, self.load_timeout_s)
@@ -381,3 +407,10 @@ class CacheManager:
 
     def close(self) -> None:
         self.runtime.close()
+        # Orphaned deadline workers (request timed out, work still landing):
+        # give them a bounded window to finish so shutdown doesn't race their
+        # disk-index/runtime writes, then let daemons die with the process.
+        with self._load_workers_lock:
+            stragglers = list(self._load_workers)
+        for t in stragglers:
+            t.join(timeout=5.0)

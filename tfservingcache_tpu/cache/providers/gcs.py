@@ -27,6 +27,7 @@ from tfservingcache_tpu.cache.providers.object_store import (
     http_call,
     http_download,
 )
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 _METADATA_TOKEN_URL = (
     "http://metadata.google.internal/computeMetadata/v1/instance/"
@@ -35,7 +36,11 @@ _METADATA_TOKEN_URL = (
 _METADATA_RETRY_S = 60.0
 
 
+@lockchecked
 class GCSModelProvider(ObjectStoreProvider):
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_token": "_token_lock", "_token_expiry": "_token_lock"}
+
     def __init__(self, bucket: str, base_path: str = "", endpoint: str = "") -> None:
         super().__init__(base_path)
         if not bucket:

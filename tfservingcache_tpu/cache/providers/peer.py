@@ -27,6 +27,7 @@ from tfservingcache_tpu.cache.providers.base import ModelProvider
 from tfservingcache_tpu.types import Model, ModelId, NodeInfo
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.tracing import TRACER
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 log = get_logger("peer_provider")
 
@@ -36,9 +37,13 @@ log = get_logger("peer_provider")
 _MIN_WARMTH = 2
 
 
+@lockchecked
 class PeerProvider(ModelProvider):
     """Decorator provider; constructed unbound (pass-through) by CacheNode
     and bound to the fleet by the Router once discovery is up."""
+
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_channels": "_lock"}
 
     def __init__(
         self,

@@ -17,13 +17,22 @@ from __future__ import annotations
 import bisect
 import hashlib
 import threading
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 
 def _point(data: str) -> int:
     return int.from_bytes(hashlib.blake2b(data.encode(), digest_size=8).digest(), "big")
 
 
+@lockchecked
 class HashRing:
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {
+        "_points": "_lock",
+        "_owners": "_lock",
+        "_members": "_lock",
+    }
+
     def __init__(self, vnodes: int = 160) -> None:
         self.vnodes = vnodes
         self._lock = threading.Lock()

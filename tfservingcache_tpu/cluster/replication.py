@@ -27,6 +27,7 @@ import threading
 from typing import Mapping
 
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 
 log = get_logger("replication")
@@ -49,6 +50,7 @@ class _KeyState:
         self.peak = 0
 
 
+@lockchecked
 class ReplicaController:
     """Per-model replica target driven by routed in-flight load.
 
@@ -57,6 +59,9 @@ class ReplicaController:
     forwarded or short-circuited request (write side). ``evaluate()`` is
     one synchronous tick — the periodic task calls it, and tests drive it
     directly for determinism."""
+
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_keys": "_lock", "_warming": "_lock"}
 
     def __init__(
         self,
@@ -103,7 +108,8 @@ class ReplicaController:
 
     # -- read side (ClusterConnection.replicas_for_key) ---------------------
     def replicas_for(self, key: str) -> int:
-        st = self._keys.get(key)  # GIL-safe read; no lock on the hot path
+        with self._lock:  # uncontended in steady state; dict.get is O(1)
+            st = self._keys.get(key)
         return st.target if st is not None else self.base
 
     # -- control loop -------------------------------------------------------

@@ -60,8 +60,6 @@ from tfservingcache_tpu.utils.logging import get_logger
 
 if TYPE_CHECKING:  # import only for annotations: keep this module light
     from tfservingcache_tpu.cache.manager import CacheManager
-    from tfservingcache_tpu.cluster.cluster import ClusterConnection
-    from tfservingcache_tpu.utils.metrics import Metrics
 
 log = get_logger("status")
 

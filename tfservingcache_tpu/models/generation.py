@@ -645,7 +645,7 @@ def _rope_per_example(x, positions, theta):
     return rot.reshape(x.shape).astype(x.dtype)
 
 
-def generate(
+def generate(  # static-bounded: cfg_key, max_new_tokens, return_cache -- cfg_key is per-model config; runtime callers pass pow2-bucketed max_new_tokens (next_bucket); return_cache is boolean
     model_def: Any,
     params: Any,
     input_ids,
@@ -696,7 +696,7 @@ def generate(
     )
 
 
-def generate_from_cache(
+def generate_from_cache(  # static-bounded: cfg_key, max_new_tokens, return_cache -- cfg_key is per-model config; runtime callers pass pow2-bucketed max_new_tokens (next_bucket); return_cache is boolean
     model_def: Any,
     params: Any,
     suffix_ids,

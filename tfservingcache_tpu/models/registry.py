@@ -132,7 +132,7 @@ def families() -> list[str]:
     return sorted(_REGISTRY)
 
 
-_BUILD_CACHE: dict[str, ModelDef] = {}
+_BUILD_CACHE: dict[str, ModelDef] = {}  # guarded-by: _BUILD_LOCK
 _BUILD_LOCK = threading.Lock()
 
 

@@ -75,7 +75,7 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rot.reshape(x.shape).astype(x.dtype)
 
 
-def _attention_block(params: dict, x: jax.Array, cfg: dict, mesh=None) -> jax.Array:
+def _attention_block(params: dict, x: jax.Array, cfg: dict, mesh=None) -> jax.Array:  # static-bounded: mesh -- one Mesh object per runtime lifetime
     b, s, d_model = x.shape
     n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
     head_dim = d_model // n_heads

@@ -26,6 +26,8 @@ from typing import Any, Callable, Generic, Hashable, Iterator, TypeVar
 
 from tfservingcache_tpu.cache.lru import CapacityError, LRUEntry
 
+from tfservingcache_tpu.utils.lockcheck import lockchecked
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libtpusc_native.so")
 
@@ -251,6 +253,7 @@ def _key_str(key: Any) -> str:
     return s
 
 
+@lockchecked
 class NativeLRUCache(Generic[K, V]):
     """Drop-in for ``cache.lru.LRUCache``: the (key, size, order, budget)
     index lives in C++; payloads and evict callbacks stay on the Python side.
@@ -258,6 +261,9 @@ class NativeLRUCache(Generic[K, V]):
     Same contract as the Python tier: thread-safe, single eviction pass per
     put, oversized items rejected, callbacks run outside the native lock.
     """
+
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_payloads": "_lock"}
 
     def __init__(
         self,

@@ -550,7 +550,7 @@ def paged_decode_attention(
 TPU_BACKENDS = ("tpu", "axon")  # axon = tunneled TPU plugin in this image
 
 
-def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> jax.Array:  # static-bounded: causal -- boolean domain (two programs max)
     """Dispatch: Pallas flash kernel on TPU, jnp reference elsewhere (the
     kernel's interpret mode is for tests, too slow for CPU serving).
 

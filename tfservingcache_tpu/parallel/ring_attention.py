@@ -20,7 +20,7 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
 
@@ -54,7 +54,7 @@ def _block_attend(q, k, v, q_off, k_off, causal, acc, m, l):
     return acc * alpha + pv, m_new, l_new
 
 
-def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool,
+def _ring_shard_fn(q, k, v, *, axis: str, n_shards: int, causal: bool,  # static-bounded: causal, interpret -- boolean domains
                    impl: str = "xla", interpret: bool = False):
     """Per-shard body under shard_map: local (B, H, S/P, D) blocks. K/V ride
     the ring in their input dtype — rotating bf16 instead of upcast f32

@@ -52,6 +52,7 @@ from tfservingcache_tpu.models.registry import (
     _ALIGN,
 )
 from tfservingcache_tpu.utils.logging import get_logger
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 log = get_logger("peer_transfer")
 
@@ -481,6 +482,7 @@ def fetch_from_peer(
         rx.close()
 
 
+@lockchecked
 class PeerSource:
     """Outbound side: serves this node's host-tier entries to peers.
 
@@ -490,6 +492,9 @@ class PeerSource:
     in-flight cap and the pin/unpin discipline around each stream
     (ISSUE 8 satellite 1: an outbound read must neither perturb LRU order
     nor race eviction)."""
+
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_inflight": "_lock"}
 
     def __init__(
         self,

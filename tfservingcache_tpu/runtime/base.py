@@ -21,6 +21,7 @@ import numpy as np
 
 from tfservingcache_tpu.models.registry import TensorSpec
 from tfservingcache_tpu.types import Model, ModelId, ModelState
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 
 class RuntimeError_(Exception):
@@ -49,7 +50,11 @@ class GroupUnhealthyError(RuntimeError_):
     HTTP 503 / gRPC UNAVAILABLE."""
 
 
+@lockchecked
 class BaseRuntime(abc.ABC):
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_states": "_states_lock"}
+
     def __init__(self) -> None:
         self._states: dict[ModelId, ModelState] = {}
         self._states_lock = threading.Lock()

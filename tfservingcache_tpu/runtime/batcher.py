@@ -46,11 +46,11 @@ import numpy as np
 
 from tfservingcache_tpu.runtime.base import (
     BaseRuntime,
-    ModelNotLoadedError,
     RuntimeError_,
 )
 from tfservingcache_tpu.types import ModelId
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.tracing import TRACER, current_ids
 
@@ -62,6 +62,7 @@ log = get_logger("runtime.batcher")
 from tfservingcache_tpu.runtime.model_runtime import next_bucket as _next_bucket
 
 
+@lockchecked
 class _Gate:
     """A counted gate admitting up to ``limit`` concurrent holders.
 
@@ -74,6 +75,8 @@ class _Gate:
     accumulate-while-busy behavior (leaders still block once ``limit``
     batches are in flight, and arrivals join the blocked leader's batch)
     while letting ``limit`` batches overlap host codec + transfer + compute."""
+
+    _tpusc_guarded = {"in_use": "_count"}
 
     def __init__(self, limit: int) -> None:
         self._sem = threading.BoundedSemaphore(limit)
@@ -92,6 +95,7 @@ class _Gate:
         self._sem.release()
 
 
+@lockchecked
 class _GateMap:
     """Per-key device gates with bounded growth (shared by MicroBatcher and
     GenerateCoalescer): bound how many batches per key are in flight so
@@ -99,6 +103,8 @@ class _GateMap:
     Pruning keeps only in-use gates; losing an idle gate only costs a
     coalescing opportunity (or briefly exceeds the in-flight bound), never
     correctness."""
+
+    _tpusc_guarded = {"_gates": "_lock"}
 
     def __init__(self, max_entries: int = 4096, limit: int = 4) -> None:
         self._lock = threading.Lock()
@@ -134,7 +140,15 @@ class _Pending:
     closed: bool = False                  # no further joiners
 
 
+@lockchecked
 class MicroBatcher:
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {
+        "_pending": "_lock",
+        "_axes_cache": "_lock",
+        "_out_axes_cache": "_lock",
+    }
+
     def __init__(
         self,
         runtime: BaseRuntime,
@@ -370,6 +384,7 @@ class _GenPending:
     closed: bool = False
 
 
+@lockchecked
 class GenerateCoalescer:
     """Continuous batching for ``:generate`` — the verb LM clients actually
     call (VERDICT r2 next-round #8). Same gate design as MicroBatcher: the
@@ -384,6 +399,8 @@ class GenerateCoalescer:
     ``seed`` NEVER coalesce: their contract is a reproducible solo sample
     stream, which a shared batch draw would silently break.
     """
+
+    _tpusc_guarded = {"_pending": "_lock"}
 
     def __init__(
         self,
@@ -658,12 +675,15 @@ class _ContinuousReq:
     prefill_s: float = 0.0                # slot_prefill wall time (phase clock)
 
 
+@lockchecked
 class _ContinuousScheduler:
     """One model's decode loop: a dedicated thread that admits pending rows
     into free slot lanes at chunk boundaries, dispatches the compiled
     decode-chunk program over the slot array, and retires rows the moment
     they hit EOS or their own max_new_tokens — freeing the lane for the
     next pending row instead of waiting for a batch drain."""
+
+    _tpusc_guarded = {"pending": "cv", "stopped": "cv"}
 
     def __init__(self, engine: "ContinuousGenerateEngine", model_id: ModelId) -> None:
         self.engine = engine
@@ -1066,6 +1086,7 @@ class _ContinuousScheduler:
             )
 
 
+@lockchecked
 class ContinuousGenerateEngine:
     """Iteration-level continuous batching for ``:generate`` — the vLLM-/
     DeepServe-style alternative to GenerateCoalescer, selected via
@@ -1086,6 +1107,14 @@ class ContinuousGenerateEngine:
     lockstep device-op stream must not depend on a host scheduler thread)
     all fall through to ``runtime.generate``.
     """
+
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {
+        "_scheds": "_lock",
+        "_active": "_lock",
+        "_pages": "_lock",
+        "_closed": "_lock",
+    }
 
     def __init__(
         self,

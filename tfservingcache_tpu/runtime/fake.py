@@ -17,10 +17,14 @@ import numpy as np
 from tfservingcache_tpu.models.registry import TensorSpec
 from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
 from tfservingcache_tpu.types import Model, ModelId, ModelState
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 
+@lockchecked
 class FakeRuntime(BaseRuntime):
     """predict(x) = x * version + bias, so tests can tell versions apart."""
+
+    _tpusc_guarded = {"_loaded": "_lock"}
 
     def __init__(
         self,
@@ -139,4 +143,7 @@ class FakeRuntime(BaseRuntime):
 
     @property
     def hbm_bytes_in_use(self) -> int:
-        return sum(m.size_on_disk for m in self._loaded.values())
+        # lock: iterating an unlocked dict races a concurrent load's insert
+        # (RuntimeError: dictionary changed size during iteration)
+        with self._lock:
+            return sum(m.size_on_disk for m in self._loaded.values())

@@ -36,6 +36,7 @@ from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, load_artifa
 from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
 from tfservingcache_tpu.types import Model, ModelId, ModelState
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
 from tfservingcache_tpu.utils.tracing import TRACER
@@ -643,7 +644,20 @@ class SlotDecodeState:
                 )
 
 
+@lockchecked
 class TPUModelRuntime(BaseRuntime):
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {
+        "_load_locks": "_load_locks_guard",
+        "_adopted": "_adopted_lock",
+        "_spec_health": "_spec_lock",
+        "_jitted_by_key": "_jit_lock",
+        "_aot_cache": "_aot_lock",
+        "_aot_futures": "_aot_lock",
+        "_slot_states": "_slot_lock",
+        "_slot_init_guards": "_slot_lock",
+    }
+
     def __init__(
         self,
         cfg: ServingConfig | None = None,
@@ -706,9 +720,10 @@ class TPUModelRuntime(BaseRuntime):
 
             self._host_tier = HostRamTier(host_tier_bytes, metrics)
             self._demote_queue = queue.Queue()
-            threading.Thread(
+            self._demote_thread = threading.Thread(
                 target=self._demote_loop, name="tpusc-demote", daemon=True
-            ).start()
+            )
+            self._demote_thread.start()
         # prefix KV cache (OFF unless budgeted). Mesh/group runtimes get it
         # too (VERDICT r5 #7): on a cross-host group every process's cache
         # evolves identically under the lockstep op stream, the LEADER's hit
@@ -769,10 +784,21 @@ class TPUModelRuntime(BaseRuntime):
             return "hbm"
         with self._load_locks_guard:
             lock = self._load_locks.setdefault(mid, threading.Lock())
-        with lock:
-            if self.is_loaded(mid):  # singleflight: someone else finished it
-                return "hbm"
-            return self._load(model)
+        try:
+            with lock:
+                if self.is_loaded(mid):  # singleflight: someone else finished it
+                    return "hbm"
+                return self._load(model)
+        finally:
+            # Failure-path pruning (mirror of _on_evict): a model whose load
+            # keeps failing never becomes resident, so the evict-side prune
+            # never runs for it and a storm of failing tenants would grow
+            # this dict without bound. Drop the idle lock when nothing landed.
+            if not self.is_loaded(mid):
+                with self._load_locks_guard:
+                    held = self._load_locks.get(mid)
+                    if held is lock and not held.locked():
+                        del self._load_locks[mid]
 
     def adopt_packed_entry(self, model_id: ModelId, entry: Any) -> None:
         """Hand over a transfer-ready ``PackedModelEntry`` that did NOT come
@@ -799,7 +825,7 @@ class TPUModelRuntime(BaseRuntime):
         with self._jit_lock:
             if entry.model_def.cache_key in self._jitted_by_key:
                 return
-        entry.jitted = jax.jit(entry.model_def.apply)
+            entry.jitted = jax.jit(entry.model_def.apply)
 
     def _load(self, model: Model) -> str:
         mid = model.identifier
@@ -1222,7 +1248,7 @@ class TPUModelRuntime(BaseRuntime):
             self._aot_futures[key] = fut
             return fut
 
-    def _aot_compile(
+    def _aot_compile(  # jit-surface: AOT warmup, one-shot per key via _aot_futures under _aot_lock
         self, model_def: ModelDef, abs_params: Any, key: tuple
     ) -> tuple[Any, float, float]:
         import jax
@@ -1291,19 +1317,23 @@ class TPUModelRuntime(BaseRuntime):
         else through jit dispatch. jax.jit never sees AOT-compiled programs,
         so without this routing the first predict after a pipelined load at
         the warmup shape would silently recompile."""
-        if self._aot_cache:
-            key = (loaded.model_def.cache_key, self._inputs_sig(padded))
-            with self._aot_lock:
+        # one uncontended acquire per predict (_aot_lock only ever guards
+        # dict ops, never a compile); the common no-AOT case skips the
+        # signature computation entirely
+        key = entry = None
+        with self._aot_lock:
+            if self._aot_cache:
+                key = (loaded.model_def.cache_key, self._inputs_sig(padded))
                 entry = self._aot_cache.get(key)
-            if entry is not None:
-                try:
-                    return entry[0](loaded.params, dict(padded))
-                except Exception as e:  # noqa: BLE001 - jit path always works
-                    log.warning(
-                        "AOT executable rejected inputs (%s); using jit", e
-                    )
-                    with self._aot_lock:
-                        self._aot_cache.pop(key, None)
+        if entry is not None:
+            try:
+                return entry[0](loaded.params, dict(padded))
+            except Exception as e:  # noqa: BLE001 - jit path always works
+                log.warning(
+                    "AOT executable rejected inputs (%s); using jit", e
+                )
+                with self._aot_lock:
+                    self._aot_cache.pop(key, None)
         return loaded.jitted(loaded.params, padded)
 
     # -- predict ------------------------------------------------------------
@@ -1760,7 +1790,7 @@ class TPUModelRuntime(BaseRuntime):
         )
         return tok, pk, pv, hit
 
-    def _slot_prefill_impl(
+    def _slot_prefill_impl(  # static-bounded: cfg_key -- one value per resident model (model_def.config)
         self,
         model_id: ModelId,
         prompt: np.ndarray,
@@ -1854,7 +1884,7 @@ class TPUModelRuntime(BaseRuntime):
             return None
         return plan
 
-    def slot_prefill_shared(
+    def slot_prefill_shared(  # static-bounded: cfg_key -- one value per resident model (model_def.config)
         self,
         model_id: ModelId,
         state: SlotDecodeState,
@@ -2044,7 +2074,7 @@ class TPUModelRuntime(BaseRuntime):
             state.k, state.v, pk, pv, np.int32(idx)
         )
 
-    def slot_decode_chunk(self, state: SlotDecodeState, chunk: int) -> np.ndarray:
+    def slot_decode_chunk(self, state: SlotDecodeState, chunk: int) -> np.ndarray:  # static-bounded: chunk -- engine clamps to a pow2 cover (batcher: min(chunk_tokens, _next_bucket(...)))
         """Advance every active lane by ``chunk`` decode steps in one
         dispatch; updates the state's device K/V and host tok/pos mirrors
         and returns the (S, chunk) emitted tokens. Raises
@@ -2238,7 +2268,7 @@ class TPUModelRuntime(BaseRuntime):
             mid, loaded.model_def, host_params, loaded.jitted, loaded.hbm_bytes
         )
 
-    def _replicated(self, t):
+    def _replicated(self, t):  # jit-surface: one-time lazy replicate-out identity, memoized on self
         """Jitted identity with fully-replicated out_sharding (cached — a
         fresh lambda per call would retrace and recompile per request); all
         group processes execute it in lockstep."""
@@ -2556,6 +2586,7 @@ class TPUModelRuntime(BaseRuntime):
         if self._host_tier is not None:
             self._host_tier.close()  # put() no-ops from here on
             self._demote_queue.put(None)  # worker exits after queued jobs
+            self._demote_thread.join(timeout=5.0)
         self._resident.clear()
         with self._adopted_lock:
             self._adopted.clear()

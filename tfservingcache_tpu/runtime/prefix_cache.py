@@ -30,6 +30,7 @@ from typing import Any
 import numpy as np
 
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 
 
 @dataclass
@@ -48,7 +49,15 @@ class PrefixEntry:
 _MAX_ENTRIES_PER_MODEL = 32
 
 
+@lockchecked
 class PrefixCache:
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {
+        "_by_model": "_lock",
+        "_recency": "_lock",
+        "_total": "_lock",
+    }
+
     def __init__(self, capacity_bytes: int) -> None:
         self.capacity_bytes = int(capacity_bytes)
         self._lock = threading.Lock()
@@ -60,7 +69,7 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
 
-    def _best_match(self, model_id: ModelId,
+    def _best_match(self, model_id: ModelId,  # lock-held: _lock
                     prompt: np.ndarray) -> tuple[bytes | None, int]:
         """(backing key, usable rows) of the longest entry whose tokens are
         a STRICT prefix of ``prompt`` (strict: at least one suffix token must
@@ -156,10 +165,12 @@ class PrefixCache:
 
     @property
     def total_bytes(self) -> int:
-        return self._total
+        with self._lock:
+            return self._total
 
     def __len__(self) -> int:
-        return sum(len(d) for d in self._by_model.values())
+        with self._lock:
+            return sum(len(d) for d in self._by_model.values())
 
     def clear(self) -> None:
         with self._lock:

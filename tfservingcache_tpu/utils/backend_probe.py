@@ -18,7 +18,7 @@ import threading
 import time
 
 # per-process memo for cached_backend_answers(); None = never probed
-_memo: tuple[bool, str] | None = None
+_memo: tuple[bool, str] | None = None  # guarded-by: _memo_lock
 _memo_lock = threading.Lock()
 
 

@@ -48,6 +48,8 @@ from typing import Any
 
 from tfservingcache_tpu.utils.logging import get_logger
 
+from tfservingcache_tpu.utils.lockcheck import lockchecked
+
 log = get_logger("flight_recorder")
 
 # One record per dispatched chunk / batch drain. Fixed tuple layout (not a
@@ -107,7 +109,13 @@ class _Ring:
         return out
 
 
+@lockchecked
 class FlightRecorder:
+    # Registry entries are checked statically AND dynamically; _rings/_phases
+    # carry static-only "# guarded-by:" comments instead because their hot-path
+    # readers are deliberately lock-free (see waivers.txt).
+    _tpusc_guarded = {"_dumped_keys": "_lock", "_last_dump": "_lock"}
+
     def __init__(
         self,
         ring_entries: int = DEFAULT_RING_ENTRIES,
@@ -120,8 +128,8 @@ class FlightRecorder:
         self.max_dumps = max(1, int(max_dumps))
         self.dump_cooldown_s = float(dump_cooldown_s)
         self._lock = threading.Lock()        # structure mutations only
-        self._rings: dict[str, _Ring] = {}
-        self._phases: dict[str, collections.deque] = {}
+        self._rings: dict[str, _Ring] = {}  # guarded-by: _lock
+        self._phases: dict[str, collections.deque] = {}  # guarded-by: _lock
         self._marks: dict[str, float] = {}
         self._dump_seq = itertools.count()
         self._dumped_keys: collections.deque = collections.deque(maxlen=256)

@@ -36,6 +36,8 @@ import re
 import threading
 import time
 import zlib
+
+from tfservingcache_tpu.utils.lockcheck import lockchecked
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Iterator
@@ -219,7 +221,11 @@ def deserialize_span(payload: str | bytes) -> Span | None:
         return None
 
 
+@lockchecked
 class Tracer:
+    # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
+    _tpusc_guarded = {"_traces": "_lock", "_slow": "_lock"}
+
     def __init__(
         self,
         capacity: int = 256,

@@ -1,0 +1,18 @@
+"""tpusc-check: repo-native static analysis for lock discipline, thread
+lifecycle, JIT-retrace hazards, and metrics declaration discipline.
+
+Run standalone:  ``python -m tools.tpusc_check tfservingcache_tpu/``
+Run in tier-1:   ``pytest tests/test_static_analysis.py``
+
+See LINT.md for the rule catalogue, annotation syntax, and waiver format.
+"""
+
+from .analyzer import (  # noqa: F401
+    Violation,
+    Waiver,
+    load_waivers,
+    parse_file,
+    run_check,
+)
+
+DEFAULT_WAIVERS = "tools/tpusc_check/waivers.txt"

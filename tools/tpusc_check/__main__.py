@@ -1,0 +1,42 @@
+"""CLI entry point: ``python -m tools.tpusc_check [paths...]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+from . import DEFAULT_WAIVERS, load_waivers, run_check
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpusc_check",
+        description="Repo-native static analysis (see LINT.md).",
+    )
+    ap.add_argument("paths", nargs="*", default=["tfservingcache_tpu"], help="files or dirs")
+    ap.add_argument("--waivers", default=DEFAULT_WAIVERS, help="waiver file path")
+    ap.add_argument("--show-waived", action="store_true", help="also print waived violations")
+    args = ap.parse_args(argv)
+
+    root = Path.cwd()
+    waivers = load_waivers(Path(args.waivers))
+    t0 = time.monotonic()
+    violations, waived = run_check([Path(p) for p in args.paths], waivers, root=root)
+    dt = time.monotonic() - t0
+
+    for v in violations:
+        print(v.render())
+    if args.show_waived:
+        for v, w in waived:
+            print(f"waived: {v.render()}  ({w.reason})")
+    print(
+        f"tpusc-check: {len(violations)} violation(s), {len(waived)} waived, "
+        f"{dt * 1000:.0f} ms"
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

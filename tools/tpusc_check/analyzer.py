@@ -1,0 +1,285 @@
+"""Core plumbing for tpusc-check: file model, annotations, waivers, driver.
+
+The analyzer is deliberately repo-native: it understands this codebase's
+locking idioms (``with self._lock:`` scoping, ``_tpusc_guarded`` registries,
+``# guarded-by:`` trailing comments) rather than attempting a general-purpose
+race detector.  See LINT.md for the rule catalogue and annotation syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# Annotation comment grammar (trailing comments; extracted via tokenize so
+# '#' inside string literals never confuses us).
+GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
+LOCKHELD_RE = re.compile(r"lock-held:\s*([A-Za-z_][\w,\s]*?)(?:--|$)")
+JIT_SURFACE_RE = re.compile(r"jit-surface:\s*(\S.*)")
+STATIC_BOUNDED_RE = re.compile(r"static-bounded:\s*([A-Za-z_][\w,\s]*?)(?:--|$)")
+
+GUARDED_REGISTRY_ATTR = "_tpusc_guarded"
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    qualname: str  # Class.method / function / <module>
+    message: str
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}::{self.qualname}"
+
+    def render(self) -> str:
+        return f"{self.rule} {self.path}:{self.line} [{self.qualname}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Waiver:
+    rule: str
+    pattern: str  # fnmatch pattern over "path::qualname"
+    reason: str
+
+    def matches(self, v: Violation) -> bool:
+        if self.rule != "*" and self.rule != v.rule:
+            return False
+        return fnmatch.fnmatch(v.site, self.pattern) or fnmatch.fnmatch(v.path, self.pattern)
+
+
+def load_waivers(path: Path) -> list[Waiver]:
+    """Parse the waiver file: ``RULE  path::qualname-glob -- justification``."""
+    waivers: list[Waiver] = []
+    if not path.exists():
+        return waivers
+    for lineno, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, sep, reason = line.partition("--")
+        parts = head.split()
+        if len(parts) != 2 or not sep or not reason.strip():
+            raise ValueError(
+                f"{path}:{lineno}: malformed waiver (want 'RULE pattern -- reason'): {raw!r}"
+            )
+        waivers.append(Waiver(rule=parts[0], pattern=parts[1], reason=reason.strip()))
+    return waivers
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    node: ast.ClassDef
+    # guarded field name -> lock attribute name (merged registry + comments)
+    guarded: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class FileInfo:
+    path: Path
+    relpath: str
+    tree: ast.Module
+    lines: list[str]
+    comments: dict[int, str]  # lineno -> comment text (without '#')
+    parents: dict[int, ast.AST]  # id(node) -> parent node
+    imports: dict[str, str]  # local name -> dotted module/attr it binds
+    classes: list[ClassInfo] = field(default_factory=list)
+    module_guarded: dict[str, str] = field(default_factory=dict)  # global -> lock global
+
+    # -- navigation -------------------------------------------------------
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> list[ast.AST]:
+        """Innermost-first chain of enclosing function defs.
+
+        A node sitting in a function's decorator list is *not* inside that
+        function (decorators evaluate in the enclosing scope).
+        """
+        out = []
+        prev = node
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_decorator = any(
+                    prev is d or any(prev is sub for sub in ast.walk(d))
+                    for d in anc.decorator_list
+                )
+                if not in_decorator:
+                    out.append(anc)
+            prev = anc
+        return out
+
+    def qualname(self, node: ast.AST) -> str:
+        names = []
+        for anc in [node, *self.ancestors(node)]:
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                names.append(anc.name)
+        return ".".join(reversed(names)) or "<module>"
+
+    def comment_on(self, lineno: int) -> str:
+        return self.comments.get(lineno, "")
+
+    def def_annotation(self, func: ast.AST, regex: re.Pattern) -> list[str]:
+        """Parse an annotation comment on a def line (e.g. ``# lock-held: _lock``)."""
+        m = regex.search(self.comment_on(func.lineno))
+        if not m:
+            return []
+        return [tok.strip() for tok in m.group(1).split(",") if tok.strip()]
+
+
+def _extract_comments(source: str) -> dict[int, str]:
+    comments: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string.lstrip("#").strip()
+    except tokenize.TokenError:
+        pass
+    return comments
+
+
+def _collect_imports(tree: ast.Module) -> dict[str, str]:
+    imports: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                imports[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                imports[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return imports
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_classes(fi: FileInfo) -> None:
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = ClassInfo(name=node.name, node=node)
+        # Class-level registry: _tpusc_guarded = {"_field": "_lock"}
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == GUARDED_REGISTRY_ATTR
+            ):
+                try:
+                    reg = ast.literal_eval(stmt.value)
+                except (ValueError, SyntaxError):
+                    reg = None
+                if isinstance(reg, dict):
+                    ci.guarded.update({str(k): str(v) for k, v in reg.items()})
+        # Trailing-comment form on self.X assignments anywhere in the class.
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None:
+                        continue
+                    m = GUARDED_RE.search(fi.comment_on(sub.lineno))
+                    if m:
+                        ci.guarded[attr] = m.group(1)
+        fi.classes.append(ci)
+    # Module-level guarded globals: X = ... # guarded-by: _x_lock
+    for stmt in fi.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            m = GUARDED_RE.search(fi.comment_on(stmt.lineno))
+            if not m:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    fi.module_guarded[tgt.id] = m.group(1)
+
+
+def parse_file(path: Path, root: Path) -> FileInfo:
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    try:
+        relpath = path.relative_to(root).as_posix()
+    except ValueError:
+        relpath = path.as_posix()
+    fi = FileInfo(
+        path=path,
+        relpath=relpath,
+        tree=tree,
+        lines=source.splitlines(),
+        comments=_extract_comments(source),
+        parents=parents,
+        imports=_collect_imports(tree),
+    )
+    _collect_classes(fi)
+    return fi
+
+
+def iter_source_files(target: Path):
+    if target.is_file():
+        yield target
+        return
+    for path in sorted(target.rglob("*.py")):
+        # Generated protobuf stubs: huge, machine-written, not ours to lint.
+        if "_pb2" in path.name or "protos" in path.parts:
+            continue
+        yield path
+
+
+def run_check(
+    targets: list[Path],
+    waivers: list[Waiver],
+    root: Path | None = None,
+) -> tuple[list[Violation], list[tuple[Violation, Waiver]]]:
+    """Run every rule over ``targets``; returns (unwaivered, waived) violations."""
+    from . import rules_guarded, rules_jit, rules_metrics, rules_threads
+
+    root = root or Path.cwd()
+    infos: list[FileInfo] = []
+    for target in targets:
+        for path in iter_source_files(target):
+            infos.append(parse_file(path, root))
+
+    jit_registry = rules_jit.collect_jit_registry(infos)
+
+    raw: list[Violation] = []
+    for fi in infos:
+        raw.extend(rules_guarded.check(fi))
+        raw.extend(rules_threads.check(fi))
+        raw.extend(rules_jit.check(fi, jit_registry))
+        raw.extend(rules_metrics.check(fi))
+
+    unwaivered: list[Violation] = []
+    waived: list[tuple[Violation, Waiver]] = []
+    for v in sorted(raw, key=lambda v: (v.path, v.line, v.rule)):
+        w = next((w for w in waivers if w.matches(v)), None)
+        if w is not None:
+            waived.append((v, w))
+        else:
+            unwaivered.append(v)
+    return unwaivered, waived

@@ -1,0 +1,132 @@
+"""TPUSC001 — guarded-by lock discipline.
+
+A field declared guarded (``_tpusc_guarded`` registry or a ``# guarded-by:``
+trailing comment) may only be read or written:
+
+* inside ``with self.<lock>:`` (lexically — nested defs inherit the scope),
+* in a method whose def line carries ``# lock-held: <lock>`` (the caller's
+  obligation, checked at the call sites by the dynamic TPUSC_LOCKCHECK mode),
+* in ``__init__`` / ``__del__`` (construction and teardown are single-owner).
+
+Module-level globals annotated ``# guarded-by:`` are checked the same way
+against ``with <lock>:`` on the module-level lock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .analyzer import LOCKHELD_RE, FileInfo, Violation, _self_attr
+
+RULE = "TPUSC001"
+_EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+def _withitem_locks_self(stmt: ast.AST) -> set[str]:
+    """Lock attribute names taken by a ``with self.X [, self.Y]:`` statement."""
+    out: set[str] = set()
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _withitem_locks_global(stmt: ast.AST) -> set[str]:
+    out: set[str] = set()
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if isinstance(item.context_expr, ast.Name):
+                out.add(item.context_expr.id)
+    return out
+
+
+def _held_at(fi: FileInfo, node: ast.AST, stop: ast.AST, self_locks: bool) -> set[str]:
+    """All lock names held lexically at ``node``, walking up to ``stop``."""
+    held: set[str] = set()
+    for anc in fi.ancestors(node):
+        held |= _withitem_locks_self(anc) if self_locks else _withitem_locks_global(anc)
+        if anc is stop:
+            break
+    return held
+
+
+def check(fi: FileInfo) -> list[Violation]:
+    out: list[Violation] = []
+
+    for ci in fi.classes:
+        if not ci.guarded:
+            continue
+        for func in ast.walk(ci.node):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # Only direct methods / their nested helpers; the outermost
+            # function decides exemption and lock-held annotations.
+            encl = fi.enclosing_functions(func)
+            if encl:  # nested def: handled when walking from its outer method
+                continue
+            if func.name in _EXEMPT_METHODS:
+                continue
+            declared_held = set(fi.def_annotation(func, LOCKHELD_RE))
+            for node in ast.walk(func):
+                attr = _self_attr(node)
+                if attr is None or attr not in ci.guarded:
+                    continue
+                lock = ci.guarded[attr]
+                # Nested defs may carry their own lock-held annotation.
+                held = set(declared_held)
+                for f in fi.enclosing_functions(node):
+                    held |= set(fi.def_annotation(f, LOCKHELD_RE))
+                    if f is func:
+                        break
+                if lock in held:
+                    continue
+                if lock in _held_at(fi, node, func, self_locks=True):
+                    continue
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=fi.relpath,
+                        line=node.lineno,
+                        qualname=fi.qualname(node),
+                        message=(
+                            f"access to guarded field self.{attr} without "
+                            f"holding self.{lock} (declare '# lock-held: {lock}' "
+                            f"on the def, wrap in 'with self.{lock}:', or waive)"
+                        ),
+                    )
+                )
+
+    # Module-level guarded globals.
+    if fi.module_guarded:
+        for func in ast.walk(fi.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fi.enclosing_functions(func):
+                continue
+            declared_held = set(fi.def_annotation(func, LOCKHELD_RE))
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Name) or node.id not in fi.module_guarded:
+                    continue
+                lock = fi.module_guarded[node.id]
+                held = set(declared_held)
+                for f in fi.enclosing_functions(node):
+                    held |= set(fi.def_annotation(f, LOCKHELD_RE))
+                    if f is func:
+                        break
+                if lock in held or lock in _held_at(fi, node, func, self_locks=False):
+                    continue
+                out.append(
+                    Violation(
+                        rule=RULE,
+                        path=fi.relpath,
+                        line=node.lineno,
+                        qualname=fi.qualname(node),
+                        message=(
+                            f"access to guarded global {node.id} without "
+                            f"holding {lock}"
+                        ),
+                    )
+                )
+    return out

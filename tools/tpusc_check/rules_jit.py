@@ -1,0 +1,302 @@
+"""TPUSC003 — JIT-retrace hazards.
+
+(a) Construction of jitted callables (``jax.jit``, ``functools.partial(jax.jit,
+    ...)``, ``pjit``, ``.lower(...).compile()``) is only allowed:
+
+    * at module scope (including decorators on module/class-level defs) —
+      compiled once at import time;
+    * inside a module-level function memoized with ``functools.lru_cache`` /
+      ``functools.cache`` — bounded program count by construction;
+    * lexically under ``with self._jit_lock:`` / ``with self._aot_lock:`` —
+      the runtime's serialized memo surfaces;
+    * in a function whose def line carries ``# jit-surface: <reason>`` —
+      a reviewed one-shot/bounded construction site;
+    * or via the waiver file.
+
+(b) Arguments feeding ``static_argnums``/``static_argnames`` of known jitted
+    callables must be *bounded*: literals, attribute state (config), pow2
+    bucket covers, or clamps thereof.  Request-derived parameters are
+    unbounded unless the def line declares ``# static-bounded: <param>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .analyzer import JIT_SURFACE_RE, STATIC_BOUNDED_RE, FileInfo, Violation
+
+RULE = "TPUSC003"
+
+_JIT_LOCKS = {"_jit_lock", "_aot_lock"}
+_MEMO_DECORATORS = {"lru_cache", "cache"}
+# Calls whose result has a bounded value domain even for unbounded input:
+# pow2 bucketing gives log-many distinct values; bool gives two.
+_BUCKETING_FUNCS = {"_next_bucket", "next_bucket", "next_pow2", "_next_pow2", "bool"}
+
+
+@dataclass
+class JittedCallable:
+    name: str
+    static_names: set[str] = field(default_factory=set)
+    static_nums: set[int] = field(default_factory=set)
+    params: list[str] = field(default_factory=list)  # positional order, if known
+
+
+def _is_jax_jit(fi: FileInfo, node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr in ("jit", "pjit"):
+        if isinstance(node.value, ast.Name) and fi.imports.get(node.value.id, "").startswith("jax"):
+            return True
+    if isinstance(node, ast.Name):
+        bound = fi.imports.get(node.id, "")
+        if bound in ("jax.jit", "jax.pjit") or bound.endswith(".pjit.pjit"):
+            return True
+    return False
+
+
+def _is_partial(fi: FileInfo, node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "partial":
+        return isinstance(node.value, ast.Name) and fi.imports.get(node.value.id, "") == "functools"
+    if isinstance(node, ast.Name):
+        return fi.imports.get(node.id, "") == "functools.partial"
+    return False
+
+
+def _jit_ctor_kind(fi: FileInfo, call: ast.Call) -> str | None:
+    """'jit' | 'partial-jit' | 'aot' | None for a Call node."""
+    if _is_jax_jit(fi, call.func):
+        return "jit"
+    if _is_partial(fi, call.func) and call.args and _is_jax_jit(fi, call.args[0]):
+        return "partial-jit"
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "compile"
+        and any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "lower"
+            for sub in ast.walk(call.func.value)
+        )
+    ):
+        return "aot"
+    return None
+
+
+def _static_params_of(call: ast.Call) -> tuple[set[str], set[int]]:
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            items = [val] if isinstance(val, (str, int)) else list(val)
+            for item in items:
+                if isinstance(item, str):
+                    names.add(item)
+                elif isinstance(item, int):
+                    nums.add(item)
+    return names, nums
+
+
+def collect_jit_registry(infos: list[FileInfo]) -> dict[str, JittedCallable]:
+    """Package-wide map: callable name -> its static params.
+
+    Covers ``@functools.partial(jax.jit, static_arg...)`` decorated defs and
+    module-level ``NAME = jax.jit(fn, static_arg...)`` assignments.
+    """
+    registry: dict[str, JittedCallable] = {}
+    for fi in infos:
+        for node in ast.walk(fi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _jit_ctor_kind(fi, dec) in (
+                        "jit",
+                        "partial-jit",
+                    ):
+                        names, nums = _static_params_of(dec)
+                        if names or nums:
+                            params = [a.arg for a in node.args.args]
+                            jc = registry.setdefault(node.name, JittedCallable(node.name))
+                            jc.static_names |= names
+                            jc.static_nums |= nums
+                            jc.params = params
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _jit_ctor_kind(fi, node.value) == "jit":
+                    names, nums = _static_params_of(node.value)
+                    if not (names or nums):
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jc = registry.setdefault(tgt.id, JittedCallable(tgt.id))
+                            jc.static_names |= names
+                            jc.static_nums |= nums
+                            # Resolve positional params from the wrapped fn's
+                            # def when it lives in the same module.
+                            if node.value.args and isinstance(node.value.args[0], ast.Name):
+                                fn_name = node.value.args[0].id
+                                for sub in ast.walk(fi.tree):
+                                    if (
+                                        isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                                        and sub.name == fn_name
+                                    ):
+                                        jc.params = [a.arg for a in sub.args.args]
+    return registry
+
+
+def _under_jit_lock(fi: FileInfo, node: ast.AST) -> bool:
+    for anc in fi.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Attribute) and ce.attr in _JIT_LOCKS:
+                    return True
+    return False
+
+
+def _is_memoized_module_fn(fi: FileInfo, func: ast.AST) -> bool:
+    if fi.enclosing_functions(func):
+        return False
+    for dec in func.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = target.attr if isinstance(target, ast.Attribute) else (
+            target.id if isinstance(target, ast.Name) else ""
+        )
+        if name in _MEMO_DECORATORS:
+            return True
+    return False
+
+
+def check(fi: FileInfo, registry: dict[str, JittedCallable]) -> list[Violation]:
+    out: list[Violation] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _jit_ctor_kind(fi, node)
+        if kind is not None:
+            out.extend(_check_ctor(fi, node, kind))
+        out.extend(_check_static_args(fi, node, registry))
+    return out
+
+
+def _check_ctor(fi: FileInfo, call: ast.Call, kind: str) -> list[Violation]:
+    encl = fi.enclosing_functions(call)
+    if not encl:
+        return []  # module scope (incl. decorators): compiled at import time
+    if _is_memoized_module_fn(fi, encl[-1]) and len(encl) == 1:
+        return []
+    if _under_jit_lock(fi, call):
+        return []
+    for func in encl:
+        if fi.def_annotation(func, JIT_SURFACE_RE):
+            return []
+    what = ".lower().compile()" if kind == "aot" else "jax.jit"
+    return [
+        Violation(
+            rule=RULE,
+            path=fi.relpath,
+            line=call.lineno,
+            qualname=fi.qualname(call),
+            message=(
+                f"{what} constructed inside a function — retrace hazard on the "
+                "request path; move to module scope, an lru_cache'd module "
+                "factory, under self._jit_lock/_aot_lock, or annotate the def "
+                "'# jit-surface: <reason>'"
+            ),
+        )
+    ]
+
+
+# -- static-arg boundedness -------------------------------------------------
+
+
+def _bounded(fi: FileInfo, expr: ast.AST, func: ast.AST | None, depth: int = 0) -> bool:
+    if depth > 8:
+        return False
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Attribute):
+        return True  # config/engine/self state — not request-derived
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return all(_bounded(fi, e, func, depth + 1) for e in expr.elts)
+    if isinstance(expr, ast.BinOp):
+        return _bounded(fi, expr.left, func, depth + 1) and _bounded(
+            fi, expr.right, func, depth + 1
+        )
+    if isinstance(expr, ast.IfExp):
+        return _bounded(fi, expr.body, func, depth + 1) and _bounded(
+            fi, expr.orelse, func, depth + 1
+        )
+    if isinstance(expr, ast.Compare):
+        return True  # booleans have a two-value domain
+    if isinstance(expr, ast.Subscript):
+        return _bounded(fi, expr.value, func, depth + 1)
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (f.id if isinstance(f, ast.Name) else "")
+        if fname in _BUCKETING_FUNCS:
+            return True  # log-bounded / two-valued result domain
+        if fname == "min":
+            return any(_bounded(fi, a, func, depth + 1) for a in expr.args)
+        if fname in ("max", "int", "len"):
+            return all(_bounded(fi, a, func, depth + 1) for a in expr.args)
+        return False
+    if isinstance(expr, ast.Name) and func is not None:
+        # Declared-bounded parameters.
+        if expr.id in fi.def_annotation(func, STATIC_BOUNDED_RE):
+            return True
+        params = {a.arg for a in getattr(func, "args").args}
+        if expr.id in params:
+            return False  # request-derived argument
+        # Single-assignment local: bounded iff every assignment is bounded.
+        assigns = [
+            sub.value
+            for sub in ast.walk(func)
+            if isinstance(sub, ast.Assign)
+            and any(isinstance(t, ast.Name) and t.id == expr.id for t in sub.targets)
+        ]
+        if assigns:
+            return all(_bounded(fi, a, func, depth + 1) for a in assigns)
+        return False
+    return False
+
+
+def _check_static_args(
+    fi: FileInfo, call: ast.Call, registry: dict[str, JittedCallable]
+) -> list[Violation]:
+    f = call.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (f.id if isinstance(f, ast.Name) else "")
+    jc = registry.get(fname)
+    if jc is None:
+        return []
+    encl = fi.enclosing_functions(call)
+    func = encl[0] if encl else None
+    out: list[Violation] = []
+
+    def flag(param: str, expr: ast.AST) -> None:
+        out.append(
+            Violation(
+                rule=RULE,
+                path=fi.relpath,
+                line=call.lineno,
+                qualname=fi.qualname(call),
+                message=(
+                    f"static arg '{param}' of {fname}() fed an unbounded "
+                    f"request-derived value ({ast.unparse(expr)}) — every "
+                    "distinct value compiles a new executable; clamp to a "
+                    "pow2 cover or declare '# static-bounded: <param> <why>'"
+                ),
+            )
+        )
+
+    for kw in call.keywords:
+        if kw.arg in jc.static_names and not _bounded(fi, kw.value, func):
+            flag(kw.arg, kw.value)
+    for idx, arg in enumerate(call.args):
+        name = jc.params[idx] if idx < len(jc.params) else None
+        if (idx in jc.static_nums or (name and name in jc.static_names)) and not _bounded(
+            fi, arg, func
+        ):
+            flag(name or f"#{idx}", arg)
+    return out

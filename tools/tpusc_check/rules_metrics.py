@@ -1,0 +1,56 @@
+"""TPUSC004 — metrics declaration discipline.
+
+Prometheus metric families (Counter/Gauge/Histogram/Summary/Info) may only be
+constructed in ``utils/metrics.py``.  Everywhere else takes a ``Metrics``
+handle (or ``None``) so families stay registry-injected, documented in
+OBSERVABILITY.md, and covered by the docs-sync lint.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .analyzer import FileInfo, Violation
+
+RULE = "TPUSC004"
+_FAMILIES = {"Counter", "Gauge", "Histogram", "Summary", "Info"}
+_ALLOWED_SUFFIX = "utils/metrics.py"
+
+
+def check(fi: FileInfo) -> list[Violation]:
+    if fi.relpath.endswith(_ALLOWED_SUFFIX):
+        return []
+    out: list[Violation] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Name) and f.id in _FAMILIES:
+            # Only when the name actually binds to prometheus_client —
+            # collections.Counter et al. are fine.
+            if fi.imports.get(f.id, "").startswith("prometheus_client"):
+                name = f.id
+        elif (
+            isinstance(f, ast.Attribute)
+            and f.attr in _FAMILIES
+            and isinstance(f.value, ast.Name)
+            and fi.imports.get(f.value.id, "").startswith("prometheus_client")
+        ):
+            name = f.attr
+        if name is None:
+            continue
+        out.append(
+            Violation(
+                rule=RULE,
+                path=fi.relpath,
+                line=node.lineno,
+                qualname=fi.qualname(node),
+                message=(
+                    f"prometheus {name}(...) constructed outside utils/metrics.py — "
+                    "declare the family on the Metrics class so it stays "
+                    "registry-injected and docs-synced"
+                ),
+            )
+        )
+    return out

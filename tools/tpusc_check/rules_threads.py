@@ -1,0 +1,218 @@
+"""TPUSC002 — thread lifecycle and lock-acquire hygiene.
+
+* Every ``threading.Thread(...)`` must be daemon (``daemon=True``) or
+  provably joined (a ``.join()`` on its binding exists in the enclosing
+  function, or — when bound to ``self.<attr>`` — anywhere in the class).
+* A ``threading.Thread(...)`` whose handle is never bound at all
+  (``threading.Thread(...).start()``) is fire-and-forget: unjoinable and
+  uncapped, flagged even when daemon.
+* Lock ``.acquire()`` must be ``with``-scoped.  Bare blocking ``.acquire()``
+  is always flagged; try-lock forms (``blocking=False`` / ``timeout=``) are
+  allowed only when a matching ``.release()`` appears in the same function.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .analyzer import FileInfo, Violation, _self_attr
+
+RULE = "TPUSC002"
+
+
+def _is_thread_ctor(fi: FileInfo, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        if isinstance(f.value, ast.Name) and fi.imports.get(f.value.id, "") == "threading":
+            return True
+    if isinstance(f, ast.Name) and fi.imports.get(f.id, "") == "threading.Thread":
+        return True
+    return False
+
+
+def _receiver_repr(node: ast.AST) -> str | None:
+    """Stable textual key for a join/release receiver: 'self.x', 'name'."""
+    attr = _self_attr(node)
+    if attr is not None:
+        return f"self.{attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _method_calls_on(scope: ast.AST, method: str) -> set[str]:
+    """Receivers (as _receiver_repr keys) of ``<recv>.<method>(...)`` in scope."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == method
+        ):
+            key = _receiver_repr(node.func.value)
+            if key is not None:
+                out.add(key)
+            # ``for t in self._workers: t.join()`` — credit the iterable too.
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.For, ast.AsyncFor)) and isinstance(node.target, ast.Name):
+            loop_var = node.target.id
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == method
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == loop_var
+                ):
+                    key = _receiver_repr(node.iter)
+                    if key is not None:
+                        out.add(key)
+    return out
+
+
+def _binding_of(fi: FileInfo, call: ast.Call) -> tuple[str | None, bool]:
+    """(receiver key the Thread handle is bound to, reachable_from_container).
+
+    Unbound means the ctor result is used inline (e.g. ``.start()`` chained).
+    A handle appended/added to a container bound to self counts as
+    container-tracked (second element True) — joined via loop-over-container.
+    """
+    parent = fi.parent(call)
+    if isinstance(parent, ast.Assign):
+        for tgt in parent.targets:
+            key = _receiver_repr(tgt)
+            if key is not None:
+                return key, False
+    if isinstance(parent, ast.AnnAssign) and parent.value is call:
+        key = _receiver_repr(parent.target)
+        if key is not None:
+            return key, False
+    return None, False
+
+
+def _container_adds(scope: ast.AST, name: str) -> set[str]:
+    """self-containers that ``name`` is .add()ed / .append()ed to in scope."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("add", "append")
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id == name
+        ):
+            key = _receiver_repr(node.func.value)
+            if key is not None:
+                out.add(key)
+    return out
+
+
+def check(fi: FileInfo) -> list[Violation]:
+    out: list[Violation] = []
+
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Call) and _is_thread_ctor(fi, node):
+            out.extend(_check_thread(fi, node))
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            out.extend(_check_acquire(fi, node))
+    return out
+
+
+def _check_thread(fi: FileInfo, call: ast.Call) -> list[Violation]:
+    daemon = any(
+        kw.arg == "daemon" and isinstance(kw.value, ast.Constant) and kw.value.value is True
+        for kw in call.keywords
+    )
+    encl = fi.enclosing_functions(call)
+    func = encl[0] if encl else None
+    enclosing_class = next(
+        (a for a in fi.ancestors(call) if isinstance(a, ast.ClassDef)), None
+    )
+
+    binding, _ = _binding_of(fi, call)
+    if binding is None:
+        return [
+            Violation(
+                rule=RULE,
+                path=fi.relpath,
+                line=call.lineno,
+                qualname=fi.qualname(call),
+                message=(
+                    "fire-and-forget threading.Thread(...) — handle is never "
+                    "bound, so it can be neither joined nor capped; keep a "
+                    "tracked reference and join it from close()/stop()"
+                ),
+            )
+        ]
+
+    # Join evidence: local scope for local names; whole class for self attrs;
+    # container membership extends the search to the container's joins.
+    search_scopes: list[ast.AST] = []
+    keys = {binding}
+    if binding.startswith("self.") and enclosing_class is not None:
+        search_scopes.append(enclosing_class)
+    elif func is not None:
+        search_scopes.append(func)
+        keys |= _container_adds(func, binding)
+        for key in list(keys):
+            if key.startswith("self.") and enclosing_class is not None:
+                search_scopes.append(enclosing_class)
+
+    joined = set()
+    for scope in search_scopes:
+        joined |= _method_calls_on(scope, "join")
+    if daemon or keys & joined:
+        return []
+    return [
+        Violation(
+            rule=RULE,
+            path=fi.relpath,
+            line=call.lineno,
+            qualname=fi.qualname(call),
+            message=(
+                f"thread bound to {binding} is neither daemon=True nor joined "
+                "from any close()/stop() path in its owning scope"
+            ),
+        )
+    ]
+
+
+def _check_acquire(fi: FileInfo, call: ast.Call) -> list[Violation]:
+    recv = call.func.value  # type: ignore[union-attr]
+    recv_key = _receiver_repr(recv)
+    text = ast.unparse(recv) if recv_key is None else recv_key
+    if "lock" not in text.lower():
+        return []  # semaphores / custom acquire protocols are out of scope
+    qual = fi.qualname(call)
+    # A lock class's own __enter__/__exit__/acquire/release implement the
+    # with-protocol; calls there are the mechanism, not a violation.
+    tail = qual.rsplit(".", 1)[-1]
+    if tail in ("__enter__", "__exit__", "acquire", "release"):
+        return []
+
+    trylock = any(kw.arg in ("blocking", "timeout") for kw in call.keywords) or call.args
+    encl = fi.enclosing_functions(call)
+    if trylock and encl:
+        released = _method_calls_on(encl[0], "release")
+        if recv_key is not None and recv_key in released:
+            return []
+        if recv_key is None and any(ast.unparse(recv) in k for k in released):
+            return []
+        # fall through: try-lock without visible release
+    return [
+        Violation(
+            rule=RULE,
+            path=fi.relpath,
+            line=call.lineno,
+            qualname=qual,
+            message=(
+                f"bare {text}.acquire() — use 'with {text}:' (try-lock forms "
+                "need a matching .release() in the same function)"
+            ),
+        )
+    ]
