@@ -1,0 +1,529 @@
+"""Per-tenant cost-attribution ledger (utils/accounting.py): gauge-integral
+semantics, owner-scoped bulk sync, snapshot/summary read surfaces, the
+< 50 us note_step budget, the kv page-seconds conservation law against a
+real paged engine under a zipf-skewed soak, accounting on/off parity (same
+tokens, zero new decode executables), the noisy-neighbor flight dump with
+cooldown dedup, the /monitoring/tenants endpoint, and the two-node fleet
+aggregation e2e rendered by the tenant_top tool."""
+
+import asyncio
+import importlib.util
+import io
+import json
+import os
+import statistics
+import threading
+import time
+
+import aiohttp
+import numpy as np
+import pytest
+
+import tfservingcache_tpu.models.generation as generation
+from tfservingcache_tpu.cluster.status import FleetView, StatusExchange
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import Model, ModelId, NodeInfo
+from tfservingcache_tpu.utils.accounting import (
+    DIMENSIONS,
+    LEDGER,
+    TenantLedger,
+)
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
+
+TINY = {
+    "vocab_size": 97,
+    "d_model": 48,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 96,
+    "max_seq": 64,
+}
+PT = 8  # page size dividing max_seq (test_paged_kv.py's parity layout)
+
+
+def _export(tmp_path, name):
+    export_artifact("transformer_lm", str(tmp_path), name=name, version=1,
+                    config=TINY)
+
+
+def _runtime(tmp_path, names):
+    rt = TPUModelRuntime(ServingConfig(platform="cpu"))
+    mids = []
+    for name in names:
+        _export(tmp_path, name)
+        mid = ModelId(name, 1)
+        rt.ensure_loaded(Model(identifier=mid, path=str(tmp_path / name / "1")))
+        mids.append(mid)
+    return rt, mids
+
+
+def _ragged_prompts(rows=4, width=10, seed=0):
+    rng = np.random.default_rng(seed)
+    lens = list(int(x) for x in rng.integers(2, width + 1, rows))
+    ids = np.zeros((rows, width), np.int32)
+    for b, length in enumerate(lens):
+        ids[b, :length] = rng.integers(1, TINY["vocab_size"], length)
+    return ids, lens
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    """LEDGER and RECORDER are process-global: every test starts from an
+    empty, enabled ledger and disarmed dumps, and leaves them that way."""
+    LEDGER.clear()
+    LEDGER.configure(enabled=True, noisy_share=0.8, noisy_window_s=5.0,
+                     noisy_min_step_s=0.25)
+    RECORDER.clear()
+    RECORDER.configure(flight_dir="")
+    yield
+    LEDGER.clear()
+    LEDGER.configure(enabled=True, noisy_share=0.8, noisy_window_s=5.0,
+                     noisy_min_step_s=0.25)
+    RECORDER.clear()
+    RECORDER.configure(flight_dir="")
+
+
+# -- ledger unit semantics ----------------------------------------------------
+
+def test_note_step_accumulates_and_disabled_is_noop():
+    led = TenantLedger()
+    led.note_step("m@1", "continuous", prefill_s=0.25, decode_s=0.5,
+                  tokens_in=10, tokens_out=20)
+    led.note_step("m@1", "continuous", decode_s=0.5, tokens_out=8)
+    t = led.snapshot()["tenants"]["m@1"]["totals"]
+    assert t["prefill_step_seconds"] == pytest.approx(0.25)
+    assert t["decode_step_seconds"] == pytest.approx(1.0)
+    assert t["tokens_in"] == 10 and t["tokens_out"] == 28
+    off = TenantLedger(enabled=False)
+    off.note_step("m@1", "continuous", decode_s=1.0, tokens_out=5)
+    off.gauge_set("m@1", "kv_pages", 40)
+    off.note_arena(40)
+    off.note_load("m@1", "disk", 0.5)
+    off.note_peer_served("m@1", 1 << 20)
+    assert off.snapshot()["tenants"] == {}
+    assert off.arena_page_seconds() == 0.0
+
+
+def test_gauge_integral_brackets_wall_time():
+    """gauge_set folds prev_level x elapsed; the integral must land between
+    the tightest and loosest wall-clock brackets around the held interval."""
+    led = TenantLedger()
+    t_lo0 = time.monotonic()
+    led.gauge_set("m@1", "kv_pages", 100.0)
+    t_hi0 = time.monotonic()
+    time.sleep(0.05)
+    t_lo1 = time.monotonic()
+    led.gauge_set("m@1", "kv_pages", 0.0)
+    t_hi1 = time.monotonic()
+    got = led.snapshot()["tenants"]["m@1"]["totals"]["kv_page_seconds"]
+    assert 100.0 * (t_lo1 - t_hi0) <= got <= 100.0 * (t_hi1 - t_lo0)
+    # the level is now 0: the integral is frozen, and the live-gauge view
+    # drops the zero level
+    time.sleep(0.01)
+    snap = led.snapshot()["tenants"]["m@1"]
+    assert snap["totals"]["kv_page_seconds"] == pytest.approx(got, abs=1e-6)
+    assert "kv_pages" not in snap["gauges"]
+    # a held (non-zero) level keeps integrating at read time
+    led.gauge_set("m@1", "hbm_bytes", 1000.0)
+    time.sleep(0.01)
+    first = led.snapshot()["tenants"]["m@1"]["totals"]["hbm_byte_seconds"]
+    time.sleep(0.01)
+    second = led.snapshot()["tenants"]["m@1"]["totals"]["hbm_byte_seconds"]
+    assert second > first > 0.0
+
+
+def test_gauge_sync_zeroes_only_same_owner_absentees():
+    """The evict side of gauge_sync is owner-scoped: tier A's walk must
+    never zero tier B's residents (in-process multi-node fleets)."""
+    led = TenantLedger()
+    led.gauge_sync("hbm_bytes", {"a@1": 10.0, "b@1": 5.0}, owner="rt1")
+    led.gauge_sync("hbm_bytes", {"c@1": 7.0}, owner="rt2")
+    # rt1 evicts b: only b (rt1's absentee) drops; c (rt2's) holds
+    led.gauge_sync("hbm_bytes", {"a@1": 10.0}, owner="rt1")
+    snap = led.snapshot()["tenants"]
+    assert snap["a@1"]["gauges"]["hbm_bytes"] == 10.0
+    assert "hbm_bytes" not in snap["b@1"]["gauges"]
+    assert snap["c@1"]["gauges"]["hbm_bytes"] == 7.0
+
+
+def test_load_mix_peer_bytes_and_dominant_dims():
+    led = TenantLedger()
+    led.note_load("m@1", "disk", 0.4)
+    led.note_load("m@1", "disk", 0.2)
+    led.note_load("m@1", "peer", 1.5)
+    led.note_peer_served("n@1", 4096)
+    snap = led.snapshot()["tenants"]
+    loads = snap["m@1"]["loads"]
+    assert loads["disk"] == {"seconds": pytest.approx(0.6), "count": 2}
+    assert loads["peer"] == {"seconds": pytest.approx(1.5), "count": 1}
+    assert snap["m@1"]["totals"]["cold_load_seconds"] == pytest.approx(2.1)
+    assert snap["n@1"]["totals"]["peer_bytes_served"] == 4096
+    # DRF: each tenant owns 100% of a different dimension
+    assert snap["m@1"]["dominant_share"] == pytest.approx(1.0)
+    assert snap["m@1"]["dominant_dim"] == "cold_load_seconds"
+    assert snap["n@1"]["dominant_dim"] == "peer_bytes_served"
+
+
+def test_snapshot_top_dim_model_and_reset_window():
+    led = TenantLedger()
+    led.note_step("big@1", "continuous", decode_s=3.0, tokens_out=300)
+    led.note_step("mid@1", "continuous", decode_s=1.0, tokens_out=900)
+    led.note_step("small@1", "continuous", decode_s=0.1, tokens_out=1)
+    # default order: dominant share; dim order: that dimension's totals
+    assert led.snapshot()["top"][0] in ("big@1", "mid@1")
+    by_tok = led.snapshot(top=2, dim="tokens_out")
+    assert by_tok["top"] == ["mid@1", "big@1"]
+    assert set(by_tok["tenants"]) == {"mid@1", "big@1"}
+    # model filter distinguishes a typo from an idle tenant
+    one = led.snapshot(model="big@1")
+    assert one["model_found"] is True and list(one["tenants"]) == ["big@1"]
+    ghost = led.snapshot(model="ghost@9")
+    assert ghost["model_found"] is False and ghost["tenants"] == {}
+    assert "model_found" not in led.snapshot()  # unfiltered: no marker
+    # reset consumes the scrape marks: window re-zeroes, totals never do
+    before = led.snapshot(reset=True)["tenants"]["big@1"]
+    assert before["window"]["decode_step_seconds"] == pytest.approx(3.0)
+    after = led.snapshot()["tenants"]["big@1"]
+    assert after["window"]["decode_step_seconds"] == pytest.approx(0.0)
+    assert after["totals"]["decode_step_seconds"] == pytest.approx(3.0)
+    led.note_step("big@1", "continuous", decode_s=0.5)
+    win = led.snapshot()["tenants"]["big@1"]["window"]
+    assert win["decode_step_seconds"] == pytest.approx(0.5)
+
+
+def test_summary_wire_vectors_ordered_and_bounded():
+    led = TenantLedger()
+    for i in range(6):
+        led.note_step(f"t{i}@1", "continuous", decode_s=float(i + 1))
+    led.note_peer_served("t0@1", 999)  # t0 owns 100% of peer bytes
+    summ = led.summary(max_tenants=3)
+    assert len(summ) == 3
+    assert list(summ)[0] == "t0@1"  # dominant share 1.0 beats decode shares
+    vec = summ["t0@1"]
+    assert len(vec) == len(DIMENSIONS)
+    assert vec[DIMENSIONS.index("decode_step_seconds")] == pytest.approx(1.0)
+    assert vec[DIMENSIONS.index("peer_bytes_served")] == 999.0
+    assert led.summary(max_tenants=0) == {}
+
+
+# -- hot-path budget ----------------------------------------------------------
+
+def test_note_step_overhead_under_50us():
+    """Accounting is always on: one note_step per chunk boundary must stay
+    invisible next to a decode dispatch (< 50 us median, batch-of-1000
+    medians to ride out CI scheduler noise — the recorder guard's shape)."""
+    led = TenantLedger()
+    for _ in range(1000):  # warm allocator and code paths
+        led.note_step("warm@1", "continuous", decode_s=1e-4, tokens_out=4)
+    per_call = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            led.note_step("m@1", "continuous", prefill_s=1e-5, decode_s=1e-4,
+                          tokens_in=8, tokens_out=4, queue_depth=1)
+        per_call.append((time.perf_counter() - t0) / 1000)
+    assert statistics.median(per_call) < 50e-6, per_call
+
+
+# -- conservation against a real paged engine ---------------------------------
+
+def test_kv_page_seconds_conservation_under_zipf_soak(tmp_path):
+    """Σ per-tenant kv_page_seconds == the arena occupancy integral within
+    1%: the distinct-page census stamped per tenant at chunk boundaries
+    must add up to the independent cross-model arena integral, under a
+    zipf-skewed two-tenant soak on one shared arena."""
+    rt, (mid_hot, mid_cold) = _runtime(tmp_path, ["hot", "cold"])
+    eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                   page_tokens=PT, arena_pages=32)
+    # zipf-ish skew: the hot tenant issues 4x the cold tenant's requests,
+    # concurrently, so both models hold arena pages at once
+    def soak(mid, rounds, seed):
+        for r in range(rounds):
+            ids, lens = _ragged_prompts(rows=4, width=10, seed=seed + r)
+            eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+    try:
+        threads = [
+            threading.Thread(target=soak, args=(mid_hot, 4, 11)),
+            threading.Thread(target=soak, args=(mid_cold, 1, 97)),
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        eng.close()
+        rt.close()
+    snap = LEDGER.snapshot()
+    per_tenant = {
+        t: row["totals"]["kv_page_seconds"]
+        for t, row in snap["tenants"].items()
+    }
+    assert set(per_tenant) == {"hot@1", "cold@1"}
+    # after close, every level is stamped back to zero: integrals frozen
+    assert all(
+        "kv_pages" not in row["gauges"] for row in snap["tenants"].values()
+    )
+    arena = LEDGER.arena_page_seconds()
+    assert arena > 0.0
+    total = sum(per_tenant.values())
+    assert abs(total - arena) <= 0.01 * arena, (per_tenant, arena)
+    # the skew shows up in the attribution: 4x the requests, more page time
+    assert per_tenant["hot@1"] > per_tenant["cold@1"]
+    # step/token dimensions landed too (the same chunk boundaries)
+    hot = snap["tenants"]["hot@1"]["totals"]
+    assert hot["decode_step_seconds"] > 0.0 and hot["tokens_out"] > 0
+
+
+def test_accounting_off_same_tokens_zero_new_executables(tmp_path):
+    """The ledger is bookkeeping, not model code: disabling it changes no
+    generated token and compiles no new decode executable (note_step and
+    the gauge stamps live outside traced code)."""
+    ids, lens = _ragged_prompts(rows=3, width=9, seed=5)
+    outs = {}
+    baseline = None
+    for arm in ("on", "off"):
+        LEDGER.clear()
+        LEDGER.configure(enabled=(arm == "on"))
+        rt, (mid,) = _runtime(tmp_path / arm, ["lm"])
+        eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                       page_tokens=PT, arena_pages=32)
+        try:
+            outs[arm] = np.asarray(
+                eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+            )
+        finally:
+            eng.close()
+            rt.close()
+        if arm == "on":
+            baseline = generation._paged_decode_chunk_jit._cache_size()
+            on_snap = LEDGER.snapshot()
+            assert on_snap["tenants"]["lm@1"]["totals"]["tokens_out"] > 0
+            assert on_snap["arena_page_seconds"] > 0.0
+    assert generation._paged_decode_chunk_jit._cache_size() == baseline
+    np.testing.assert_array_equal(outs["on"], outs["off"])
+    off_snap = LEDGER.snapshot()
+    assert off_snap["tenants"] == {} and off_snap["arena_page_seconds"] == 0.0
+
+
+# -- noisy-neighbor dump ------------------------------------------------------
+
+def test_noisy_neighbor_dump_once_with_cooldown(tmp_path):
+    """One incident -> one flight dump: the share exceedance fires on the
+    first qualifying step (another tenant queued), and RECORDER's
+    per-(reason, model) cooldown swallows the rest of the stream."""
+    RECORDER.configure(flight_dir=str(tmp_path / "flight"))
+    led = TenantLedger(noisy_share=0.6, noisy_window_s=5.0,
+                       noisy_min_step_s=0.1)
+    # a background tenant with rows actually queued behind the hog
+    led.note_step("bg@1", "continuous", decode_s=0.05, queue_depth=3)
+    for _ in range(5):
+        led.note_step("hog@1", "continuous", decode_s=0.5)
+    dumps = [f for f in RECORDER.list_dumps() if "noisy_neighbor" in f]
+    assert len(dumps) == 1, dumps
+    payload = json.load(open(os.path.join(str(tmp_path / "flight"), dumps[0])))
+    assert payload["reason"] == "noisy_neighbor"
+    assert payload["model"] == "hog@1"
+    ctx = payload["context"]
+    assert ctx["step_share"] >= 0.6
+    assert ctx["share_threshold"] == 0.6
+    assert "hog@1" in ctx["tenants"]
+    # still inside the cooldown: a second burst does not re-dump
+    for _ in range(5):
+        led.note_step("hog@1", "continuous", decode_s=0.5)
+    assert len(
+        [f for f in RECORDER.list_dumps() if "noisy_neighbor" in f]
+    ) == 1
+
+
+def test_noisy_neighbor_needs_a_victim_and_min_volume():
+    """No exceedance when the hog is alone (nobody queued behind it — its
+    own queue does not count) or when the window's total step time is
+    below the minimum (idle-node noise)."""
+    led = TenantLedger(noisy_share=0.5, noisy_window_s=5.0,
+                       noisy_min_step_s=0.1)
+    with led._lock:
+        # 100% share, but the only queued tenant is the hog itself
+        for _ in range(5):
+            out = led._advance_window(
+                time.monotonic(), "solo@1", 0.5, True
+            )
+            assert out is None
+        # a victim appears: the very next hog step qualifies
+        led._advance_window(time.monotonic(), "bg@1", 0.01, True)
+        share, total = led._advance_window(
+            time.monotonic(), "solo@1", 0.5, False
+        )
+        assert share >= 0.5 and total >= 0.1
+    # below min volume: a victim is queued but the window is tiny
+    led2 = TenantLedger(noisy_share=0.5, noisy_window_s=5.0,
+                        noisy_min_step_s=10.0)
+    led2.note_step("bg@1", "continuous", decode_s=0.01, queue_depth=1)
+    with led2._lock:
+        out = led2._advance_window(time.monotonic(), "hog@1", 0.5, False)
+    assert out is None
+
+
+# -- /monitoring/tenants ------------------------------------------------------
+
+async def test_monitoring_tenants_endpoint():
+    LEDGER.note_step("m@1", "continuous", prefill_s=0.1, decode_s=0.5,
+                     tokens_in=10, tokens_out=20)
+    LEDGER.note_step("n@1", "continuous", decode_s=0.1, tokens_out=900)
+    LEDGER.note_load("m@1", "peer", 0.3)
+    rest = RestServingServer(None, require_version=False)
+    rport = await rest.start(0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{rport}/monitoring/tenants"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(base) as r:
+                assert r.status == 200
+                snap = await r.json()
+            assert snap["dimensions"] == list(DIMENSIONS)
+            row = snap["tenants"]["m@1"]
+            assert row["totals"]["tokens_out"] == 20
+            assert row["loads"]["peer"]["count"] == 1
+            # ?top + ?dim rank by the dimension
+            async with s.get(base + "?top=1&dim=tokens_out") as r:
+                ranked = await r.json()
+            assert ranked["top"] == ["n@1"]
+            # ?model marks a typo explicitly
+            async with s.get(base + "?model=ghost@9") as r:
+                ghost = await r.json()
+            assert ghost["model_found"] is False
+            # bad ?top is a 400, not a 500
+            async with s.get(base + "?top=banana") as r:
+                assert r.status == 400
+            # default scrape PEEKS; ?reset=1 consumes the window marks
+            async with s.get(base) as r:
+                peek = await r.json()
+            assert peek["tenants"]["m@1"]["window"]["tokens_out"] == 20
+            async with s.get(base + "?reset=1") as r:
+                await r.json()
+            async with s.get(base) as r:
+                after = await r.json()
+            assert after["tenants"]["m@1"]["window"]["tokens_out"] == 0
+            assert after["tenants"]["m@1"]["totals"]["tokens_out"] == 20
+    finally:
+        await rest.close()
+
+
+# -- two-node fleet aggregation e2e -------------------------------------------
+
+def _load_tenant_top_module():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "tenant_top.py")
+    spec = importlib.util.spec_from_file_location("tenant_top", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+async def test_two_node_tenant_aggregation_e2e(tmp_path):
+    """Acceptance e2e, pinned (explicit exchange rounds, no timers): two
+    in-process nodes run skewed tenant traffic on separate ledgers; one
+    poll round carries both summaries into the FleetView; the router's
+    /monitoring/cluster ranks the hot tenant first with both nodes listed;
+    the hog trips exactly one deduped noisy_neighbor dump on node A; and
+    tenant_top renders both the fleet and the node views."""
+    from tests.test_cluster import make_store
+    from tests.test_fleet_status import _node_stack
+
+    RECORDER.configure(flight_dir=str(tmp_path / "flight"))
+    LEDGER.configure(noisy_share=0.6, noisy_window_s=5.0,
+                     noisy_min_step_s=0.1)
+    store = tmp_path / "store"
+    make_store(store, [("hot", 1)])
+    # node A uses the process-global LEDGER (the default wiring, so its
+    # REST /monitoring/tenants serves the same ledger); node B gets its
+    # own injected instance — two nodes, one process, no cross-talk
+    led_b = TenantLedger()
+    manager_a, backend_a, rest_a, _, collector_a = _node_stack(
+        tmp_path, "a", store
+    )
+    manager_b, backend_b, rest_b, _, collector_b = _node_stack(
+        tmp_path, "b", store
+    )
+    collector_b.ledger = led_b
+    rport_a = await rest_a.start(0, host="127.0.0.1")
+    rport_b = await rest_b.start(0, host="127.0.0.1")
+    try:
+        # node A: a background tenant queues, then the hog dominates the
+        # step window -> exactly one noisy_neighbor dump (cooldown dedup)
+        LEDGER.note_step("bg@1", "continuous", decode_s=0.05, tokens_out=5,
+                         queue_depth=2)
+        for _ in range(6):
+            LEDGER.note_step("hot@1", "continuous", decode_s=0.5,
+                             tokens_in=40, tokens_out=80)
+        LEDGER.note_load("hot@1", "disk", 0.4)
+        noisy = [f for f in RECORDER.list_dumps() if "noisy_neighbor" in f]
+        assert len(noisy) == 1, noisy
+        # node B: more hot traffic plus a peer-serving tenant, but the hot
+        # tenant's peer share is engineered to stay dominant fleet-wide
+        led_b.note_step("hot@1", "continuous", decode_s=1.0, tokens_in=20,
+                        tokens_out=40)
+        led_b.note_peer_served("hot@1", 9000)
+        led_b.note_peer_served("edge@1", 1000)
+
+        info_a = NodeInfo("127.0.0.1", rport_a, 1)
+        info_b = NodeInfo("127.0.0.1", rport_b, 2)
+        collector_a.ident = info_a.ident
+        collector_b.ident = info_b.ident
+        fleet = FleetView()
+        exchange = StatusExchange(fleet, local={}, poll_interval_s=5.0)
+        exchange.on_update([info_a, info_b])
+        try:
+            assert await exchange.poll_once() == 2
+        finally:
+            await exchange.close()
+
+        # the router's cluster endpoint needs only the fleet view attached
+        router_rest = RestServingServer(None, require_version=False)
+        router_rest.fleet = fleet
+        rb_port = await router_rest.start(0, host="127.0.0.1")
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://127.0.0.1:{rb_port}/monitoring/cluster"
+                ) as r:
+                    assert r.status == 200
+                    snap = await r.json()
+        finally:
+            await router_rest.close()
+        tenants = snap["tenants"]
+        assert list(tenants)[0] == "hot@1"  # ordered most-expensive first
+        hot = tenants["hot@1"]
+        assert set(hot["nodes"]) == {info_a.ident, info_b.ident}
+        # per-node vectors SUM across the fleet: 6 x 0.5 on A + 1.0 on B
+        assert hot["totals"]["decode_step_seconds"] == pytest.approx(4.0)
+        assert hot["totals"]["tokens_out"] == pytest.approx(6 * 80 + 40)
+        assert hot["dominant_share"] > tenants["edge@1"]["dominant_share"]
+        assert tenants["edge@1"]["nodes"] == [info_b.ident]
+
+        # tenant_top renders both surfaces from the live payloads
+        mod = _load_tenant_top_module()
+        out = io.StringIO()
+        mod.render_fleet(snap, out=out)
+        fleet_text = out.getvalue()
+        assert "hot@1" in fleet_text and "bg@1" in fleet_text
+        assert fleet_text.index("hot@1") < fleet_text.index("edge@1")
+        node_snap = await asyncio.to_thread(
+            mod.fetch_node, f"http://127.0.0.1:{rport_a}"
+        )
+        out = io.StringIO()
+        mod.render_node(node_snap, out=out)
+        node_text = out.getvalue()
+        assert "hot@1" in node_text and "reloads:" in node_text
+        assert "disk[1x" in node_text
+        assert "edge@1" not in node_text  # node A never saw B's tenant
+    finally:
+        backend_a.close()
+        backend_b.close()
+        await rest_a.close()
+        await rest_b.close()
+        manager_a.close()
+        manager_b.close()

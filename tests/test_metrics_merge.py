@@ -139,3 +139,65 @@ async def test_rest_metrics_endpoint_merges(tmp_path):
         await exporter_runner.cleanup()
     assert "libtpu_hbm_used_bytes" in body
     assert "tpusc_models_resident" in body
+
+async def test_scrape_and_merge_sums_per_tenant_counters():
+    """Fleet aggregation mode (``metrics.scrape_sum_counters``): two nodes
+    with model_labels on export the same per-tenant counter series; the
+    merge must SUM samples with identical label sets (not let the first
+    exporter shadow the rest) while still emitting HELP/TYPE once."""
+    own = Metrics(model_labels=True)
+    peer = Metrics(model_labels=True)
+    own.tenant_tokens.labels("lm:1", "out").inc(3)
+    peer.tenant_tokens.labels("lm:1", "out").inc(4)
+    peer.tenant_tokens.labels("lm:2", "out").inc(5)  # peer-only series survives
+    # non-counter duplicate: first source (own) wins, never summed
+    own.tenant_dominant_share.labels("lm:1").set(0.9)
+    peer.tenant_dominant_share.labels("lm:1").set(0.4)
+    runner, url = await serve_exporter(peer.render().decode())
+    try:
+        merged = (
+            await scrape_and_merge(own.render(), [url], sum_counters=True)
+        ).decode()
+    finally:
+        await runner.cleanup()
+    assert (
+        'tpusc_tenant_tokens_total{direction="out",model="lm:1"} 7.0' in merged
+    )
+    assert (
+        'tpusc_tenant_tokens_total{direction="out",model="lm:2"} 5.0' in merged
+    )
+    assert 'tpusc_tenant_dominant_share{model="lm:1"} 0.9' in merged
+    assert merged.count("# TYPE tpusc_tenant_tokens_total counter") == 1
+    assert merged.count("# HELP tpusc_tenant_tokens_total ") == 1
+    from prometheus_client.parser import text_string_to_metric_families
+
+    names = [f.name for f in text_string_to_metric_families(merged)]
+    assert len(names) == len(set(names))  # exposition is duplicate-free
+
+
+async def test_sum_counters_skips_corrupt_source_and_counts_error():
+    """A corrupt source degrades the summed merge loudly (scrape error
+    counter) without poisoning the parseable sources."""
+    own = Metrics(model_labels=True)
+    own.tenant_tokens.labels("lm:1", "in").inc(2)
+    r1, good_url = await serve_exporter(
+        "# HELP tpusc_tenant_tokens_total t\n"
+        "# TYPE tpusc_tenant_tokens_total counter\n"
+        'tpusc_tenant_tokens_total{direction="in",model="lm:1"} 8.0\n'
+    )
+    r2, bad_url = await serve_exporter("{{{ not prometheus text")
+    try:
+        merged = (
+            await scrape_and_merge(
+                own.render(), [good_url, bad_url], metrics=own,
+                sum_counters=True,
+            )
+        ).decode()
+    finally:
+        await r1.cleanup()
+        await r2.cleanup()
+    assert (
+        'tpusc_tenant_tokens_total{direction="in",model="lm:1"} 10.0' in merged
+    )
+    assert "{{{" not in merged
+    assert own.registry.get_sample_value("tpusc_scrape_errors_total") == 1

@@ -590,6 +590,13 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_scrape_errors",
     "tpusc_spec_draft_autodisabled",
     "tpusc_spec_tokens_per_round",
+    "tpusc_tenant_byte_seconds",
+    "tpusc_tenant_cold_load_seconds",
+    "tpusc_tenant_dominant_share",
+    "tpusc_tenant_kv_page_seconds",
+    "tpusc_tenant_peer_bytes_served",
+    "tpusc_tenant_step_seconds",
+    "tpusc_tenant_tokens",
 }
 
 
@@ -645,3 +652,28 @@ def test_tracer_overhead_per_span_budget():
                 pass
         per_span.append((time.perf_counter() - t0) / 1000)
     assert statistics.median(per_span) < 25e-6, per_span
+
+def test_model_label_cardinality_cap():
+    """max_model_labels bounds per-model series cardinality: once the cap's
+    worth of distinct name:version values exist, NEW tenants fold into the
+    __other__ bucket while every already-seen label keeps resolving to
+    itself (a churning tenant population cannot explode the registry)."""
+    from tfservingcache_tpu.utils.metrics import ALL_MODELS, OTHER_MODELS
+
+    m = Metrics(model_labels=True, max_model_labels=3)
+    assert m.model_label("a", 1) == "a:1"
+    assert m.model_label("b", 1) == "b:1"
+    assert m.model_label("c", 2) == "c:2"
+    # cap reached: overflow tenants share one bucket ...
+    assert m.model_label("d", 1) == OTHER_MODELS
+    assert m.model_label("e", 9) == OTHER_MODELS
+    # ... and existing labels still resolve (overflow never evicts)
+    assert m.model_label("a", 1) == "a:1"
+    assert m.model_label("c", 2) == "c:2"
+    # the per-tenant publish path lands overflow on the bucket series
+    m.tenant_kv_page_seconds.labels(m.model_label("d", 1)).inc(2.5)
+    assert m.registry.get_sample_value(
+        "tpusc_tenant_kv_page_seconds_total", {"model": OTHER_MODELS}
+    ) == 2.5
+    # model_labels off: everything folds to all_models, cap irrelevant
+    assert Metrics().model_label("a", 1) == ALL_MODELS

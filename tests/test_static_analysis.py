@@ -28,6 +28,18 @@ from tools.tpusc_check import Violation, Waiver, load_waivers, run_check
 ROOT = Path(__file__).resolve().parent.parent
 WAIVERS = ROOT / "tools" / "tpusc_check" / "waivers.txt"
 
+# Operator-facing CLIs gated alongside the package tree: these run on
+# on-call laptops against live nodes, so the same lock/thread/metric
+# discipline applies (the checker itself and the test client are exempt —
+# one is the linter, the other is a traffic generator).
+GATED_TOOLS = [
+    ROOT / "tools" / "engine_dump.py",
+    ROOT / "tools" / "fleet_top.py",
+    ROOT / "tools" / "tenant_top.py",
+    ROOT / "tools" / "tpu_bench_watcher.py",
+]
+GATE_PATHS = [ROOT / "tfservingcache_tpu", *GATED_TOOLS]
+
 
 def _check(tmp_path, source, relname="mod.py", waivers=()):
     p = tmp_path / relname
@@ -44,9 +56,7 @@ def _rules(violations):
 
 def test_repo_tree_is_clean_and_fast():
     t0 = time.monotonic()
-    violations, waived = run_check(
-        [ROOT / "tfservingcache_tpu"], load_waivers(WAIVERS), root=ROOT
-    )
+    violations, waived = run_check(GATE_PATHS, load_waivers(WAIVERS), root=ROOT)
     elapsed = time.monotonic() - t0
     assert not violations, "unwaivered violations:\n" + "\n".join(
         v.render() for v in violations
@@ -60,7 +70,8 @@ def test_repo_tree_is_clean_and_fast():
 
 def test_standalone_cli_runs_green():
     r = subprocess.run(
-        [sys.executable, "-m", "tools.tpusc_check", "tfservingcache_tpu"],
+        [sys.executable, "-m", "tools.tpusc_check", "tfservingcache_tpu",
+         *(str(p.relative_to(ROOT)) for p in GATED_TOOLS)],
         cwd=ROOT, capture_output=True, text=True, timeout=120,
     )
     assert r.returncode == 0, r.stdout + r.stderr
@@ -70,7 +81,7 @@ def test_standalone_cli_runs_green():
 def test_no_stale_waivers():
     """Every waiver entry matches at least one current violation site."""
     waivers = load_waivers(WAIVERS)
-    _, waived = run_check([ROOT / "tfservingcache_tpu"], waivers, root=ROOT)
+    _, waived = run_check(GATE_PATHS, waivers, root=ROOT)
     used = {w.pattern for _, w in waived}
     stale = [w.pattern for w in waivers if w.pattern not in used]
     assert not stale, f"waivers that no longer match anything: {stale}"

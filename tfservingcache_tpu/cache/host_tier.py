@@ -28,6 +28,7 @@ import numpy as np
 from tfservingcache_tpu.cache.lru import LRUEntry
 from tfservingcache_tpu.native import make_lru_cache
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
@@ -187,7 +188,21 @@ class HostRamTier:
 
     def _update_gauge(self) -> None:
         with self._pin_lock:
-            pinned = sum(e.nbytes for e in self._pinned_evicted.values())
+            parked = {
+                str(mid): float(e.nbytes)
+                for mid, e in self._pinned_evicted.items()
+            }
+            pinned = sum(parked.values())
+        # cost ledger: per-tenant host-DRAM levels (owner-scoped zeroing
+        # handles the evict side); pin-parked bytes stay on their tenant
+        # until the last unpin re-syncs without them
+        levels = {
+            str(mid): float(e.size_bytes)
+            for mid, e in self.lru.items_lru_first()
+        }
+        for mid, nbytes in parked.items():
+            levels[mid] = levels.get(mid, 0.0) + nbytes
+        LEDGER.gauge_sync("host_bytes", levels, owner=f"host:{id(self)}")
         total = self.lru.total_bytes + pinned
         peak = RECORDER.observe_watermark("host_tier_bytes", float(total))
         if self.metrics is not None:

@@ -26,6 +26,7 @@ from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.providers.base import ModelProvider
 from tfservingcache_tpu.runtime.base import BaseRuntime, LoadTimeoutError
 from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
@@ -113,6 +114,18 @@ class CacheManager:
             discard(model_id)
         else:
             self.runtime.unload(model_id)
+        self._sync_disk_ledger()
+
+    def _sync_disk_ledger(self) -> None:
+        """Stamp per-tenant disk-cache levels into the cost ledger
+        (owner-scoped: several managers sharing a process never zero each
+        other's artifacts)."""
+        levels: dict[str, float] = {}
+        for mid in self.disk_cache.list_models():
+            nbytes = self.disk_cache.size_of(mid)
+            if nbytes:
+                levels[str(mid)] = float(nbytes)
+        LEDGER.gauge_sync("disk_bytes", levels, owner=f"disk:{id(self)}")
 
     # ------------------------------------------------------------------
     def ensure_servable(self, model_id: ModelId) -> Model:
@@ -133,6 +146,7 @@ class CacheManager:
                 self.metrics.cache_hits.labels(label).inc()
                 self.metrics.reload_source.labels("hbm").inc()
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
+            LEDGER.note_load(str(model_id), "hbm", time.monotonic() - t0)
             return model
 
         deadline = t0 + self.load_timeout_s if self.load_timeout_s else None
@@ -188,6 +202,11 @@ class CacheManager:
                 self.metrics.reload_source.labels(source).inc()
                 self.metrics.cache_duration.labels(label).observe(time.monotonic() - t0)
                 self.metrics.disk_bytes_in_use.set(self.disk_cache.total_bytes)
+            # cost ledger: which tier revived this tenant and what it cost;
+            # disk levels re-stamped only on this slow path (a fetch may
+            # have put/evicted artifacts), never on the per-request fast path
+            LEDGER.note_load(str(model_id), source, time.monotonic() - t0)
+            self._sync_disk_ledger()
             return model
 
     def residency_warmth(self, model_id: ModelId) -> int:

@@ -55,11 +55,13 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
 from tfservingcache_tpu.types import NodeInfo
+from tfservingcache_tpu.utils.accounting import DIMENSIONS, LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 
 if TYPE_CHECKING:  # import only for annotations: keep this module light
     from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.utils.accounting import TenantLedger
 
 log = get_logger("status")
 
@@ -102,6 +104,11 @@ class NodeStatus:
     host_tier_bytes: int = 0
     models_resident: int = 0
     truncated: int = 0  # models dropped from ``models`` to fit the byte cap
+    # per-tenant cost summary (utils/accounting.py LEDGER.summary()):
+    # "name@version" -> positional accounting.DIMENSIONS vector, ordered by
+    # dominant share. The fleet's "who is expensive" input.
+    tenants: dict[str, list[float]] = field(default_factory=dict)
+    tenants_truncated: int = 0  # tenants dropped to fit the byte cap
 
     def to_dict(self) -> dict:
         return {
@@ -119,6 +126,8 @@ class NodeStatus:
             "host_tier_bytes": self.host_tier_bytes,
             "models_resident": self.models_resident,
             "truncated": self.truncated,
+            "tenants": self.tenants,
+            "tenants_truncated": self.tenants_truncated,
         }
 
     @classmethod
@@ -147,6 +156,12 @@ class NodeStatus:
                 host_tier_bytes=int(d.get("host_tier_bytes", 0)),
                 models_resident=int(d.get("models_resident", 0)),
                 truncated=int(d.get("truncated", 0)),
+                tenants={
+                    str(k): [float(x) for x in v]
+                    for k, v in (d.get("tenants") or {}).items()
+                    if isinstance(v, (list, tuple))
+                },
+                tenants_truncated=int(d.get("tenants_truncated", 0)),
             )
         except (TypeError, ValueError):
             return None
@@ -161,6 +176,17 @@ class NodeStatus:
         the model-free status won't fit (caller omits the attachment)."""
         d = self.to_dict()
         blob = _pack(d)
+        while len(blob) > byte_cap and d["tenants"]:
+            # the cost summary yields first: LEDGER.summary() orders it by
+            # dominant share, so halving from the tail keeps the expensive
+            # tenants visible and cuts the cheap ones
+            items = list(d["tenants"].items())
+            keep = len(items) // 2
+            d["tenants"] = dict(items[:keep])
+            d["tenants_truncated"] = (
+                self.tenants_truncated + len(self.tenants) - keep
+            )
+            blob = _pack(d)
         while len(blob) > byte_cap and d["models"]:
             items = sorted(d["models"].items(), key=lambda kv: (-kv[1], kv[0]))
             keep = len(items) // 2
@@ -228,6 +254,8 @@ class StatusCollector:
         byte_cap: int = DEFAULT_BYTE_CAP,
         max_models: int = 64,
         min_interval_s: float = 0.25,
+        ledger: "TenantLedger | None" = None,
+        max_tenants: int = 8,
     ) -> None:
         self.ident = ident
         self.manager = manager
@@ -235,6 +263,10 @@ class StatusCollector:
         self.byte_cap = int(byte_cap)
         self.max_models = max(1, int(max_models))
         self.min_interval_s = float(min_interval_s)
+        # per-tenant cost summary source: the process-wide LEDGER by
+        # default; in-process multi-node tests inject per-node instances
+        self.ledger = LEDGER if ledger is None else ledger
+        self.max_tenants = max(0, int(max_tenants))
         self._seq = 0
         self._cached: NodeStatus | None = None
         self._cached_blob: str = ""
@@ -304,6 +336,11 @@ class StatusCollector:
             st.kv_pages_free = max(0, int(total - used))
             st.kv_pages_shared = int(_gauge_value(m.gen_kv_pages_shared))
             st.host_tier_bytes = int(_gauge_value(m.host_tier_bytes))
+        if self.max_tenants > 0:
+            try:
+                st.tenants = self.ledger.summary(self.max_tenants)
+            except Exception:  # noqa: BLE001 — status must never fail serving
+                pass
         return st
 
 
@@ -422,10 +459,14 @@ class FleetView:
 
     # -- publication ---------------------------------------------------------
     def snapshot(self) -> dict:
-        """The ``GET /monitoring/cluster`` payload: per-node table plus the
-        inverted per-model fleet residency map."""
+        """The ``GET /monitoring/cluster`` payload: per-node table, the
+        inverted per-model fleet residency map, and the fleet-aggregated
+        per-tenant cost table ("who is expensive fleet-wide")."""
         nodes: dict[str, dict] = {}
         models: dict[str, dict[str, list[str]]] = {}
+        tenant_sums: dict[str, list[float]] = {}
+        tenant_nodes: dict[str, list[str]] = {}
+        n_dims = len(DIMENSIONS)
         for ident, ps in sorted(self._peers.items()):
             age = self._age(ps)
             st = ps.status
@@ -457,13 +498,56 @@ class FleetView:
                         key, {name: [] for name in TIER_NAMES.values()}
                     )
                     entry[TIER_NAMES.get(tier, "disk")].append(ident)
+                for tkey, vec in st.tenants.items():
+                    # positional DIMENSIONS vectors sum across nodes (pad
+                    # short vectors from older peers with zeros)
+                    cur = tenant_sums.setdefault(tkey, [0.0] * n_dims)
+                    for i in range(min(n_dims, len(vec))):
+                        cur[i] += vec[i]
+                    tenant_nodes.setdefault(tkey, []).append(ident)
             nodes[ident] = row
             self._publish_peer(ident, ps)
         return {
             "nodes": nodes,
             "models": models,
+            "tenants": self._aggregate_tenants(tenant_sums, tenant_nodes),
             "stale_after_s": self.stale_after_s,
             "health_threshold": self.health_threshold,
+        }
+
+    @staticmethod
+    def _aggregate_tenants(
+        sums: dict[str, list[float]], by_node: dict[str, list[str]]
+    ) -> dict[str, dict]:
+        """Fleet-wide dominant shares from the summed vectors: a tenant's
+        share of each dimension's FLEET total, maxed over dimensions —
+        recomputed from the sums, never averaged from per-node shares
+        (shares don't add). Ordered most-expensive first."""
+        n_dims = len(DIMENSIONS)
+        dim_totals = [
+            sum(vec[i] for vec in sums.values()) for i in range(n_dims)
+        ]
+        rows: dict[str, dict] = {}
+        for tkey, vec in sums.items():
+            best, best_dim = 0.0, DIMENSIONS[0]
+            for i in range(n_dims):
+                if dim_totals[i] > 0.0:
+                    s = vec[i] / dim_totals[i]
+                    if s > best:
+                        best, best_dim = s, DIMENSIONS[i]
+            rows[tkey] = {
+                "totals": {
+                    DIMENSIONS[i]: round(vec[i], 3) for i in range(n_dims)
+                },
+                "dominant_share": round(best, 6),
+                "dominant_dim": best_dim,
+                "nodes": by_node.get(tkey, []),
+            }
+        return {
+            tkey: rows[tkey]
+            for tkey in sorted(
+                rows, key=lambda t: rows[t]["dominant_share"], reverse=True
+            )
         }
 
     def _publish_peer(self, ident: str, ps: _PeerState) -> None:

@@ -211,6 +211,10 @@ class ClusterConfig:
     status_byte_cap: int = 4096
     # most models a single NodeStatus advertises (warmest win)
     status_max_models: int = 64
+    # most tenant accounting rows a single NodeStatus piggybacks (ordered
+    # by dominant share; the byte cap trims these before models). 0 turns
+    # the per-tenant fleet view off.
+    status_max_tenants: int = 8
     # collection cache: piggybacking on every response re-collects at most
     # this often (a fresh collect is <1 ms, but per-response would still
     # be wasteful at high QPS)
@@ -282,6 +286,14 @@ class MetricsConfig:
     # extra text-format exporters merged into this node's /metrics (reference
     # MetricsHandler scraping TF Serving live, pkg/taskhandler/metrics.go:16-53)
     scrape_targets: list[str] = field(default_factory=list)
+    # cardinality guard for model_labels: after this many distinct
+    # name:version values, NEW tenants fold into the "__other__" bucket so
+    # a 1000-tenant churn can't explode every {model=...} family
+    max_model_labels: int = 512
+    # scrape_targets merge mode: sum counter series with identical label
+    # sets across sources (per-tenant fleet aggregation) instead of the
+    # default family-level dedup where the first exporter wins
+    scrape_sum_counters: bool = False
 
 
 @dataclass
@@ -313,6 +325,19 @@ class ObservabilityConfig:
     # Rate limit for recurring triggers (page exhaustion); SLO-breach dumps
     # dedup per trace id instead.
     dump_cooldown_s: float = 60.0
+    # -- per-tenant resource accounting (utils/accounting.py) ---------------
+    # master switch for the cost-attribution ledger (step seconds, token
+    # counts, byte-second / page-second gauge integrals, load latencies)
+    tenant_accounting: bool = True
+    # noisy-neighbor detector: a tenant holding at least this share of the
+    # engine step-time window while OTHER tenants sit queued triggers one
+    # "noisy_neighbor" flight dump (deduped by the recorder cooldown)
+    noisy_neighbor_share: float = 0.8
+    # sliding window the share is computed over
+    noisy_neighbor_window_s: float = 5.0
+    # windows with less than this much total step time never fire (an idle
+    # node's only tenant trivially holds 100% of nothing)
+    noisy_neighbor_min_step_s: float = 0.25
 
 
 @dataclass

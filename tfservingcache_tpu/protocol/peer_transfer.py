@@ -51,6 +51,7 @@ from tfservingcache_tpu.models.registry import (
     PARAMS_BIN,
     _ALIGN,
 )
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.lockcheck import lockchecked
 
@@ -222,6 +223,10 @@ def iter_frames(entry: Any, chunk_msg_bytes: int,
             # frame instead of slice-to-bytes plus concatenate
             head = bytes([FRAME_CHUNK]) + _CHUNK_HDR.pack(ci, off)
             yield b"".join((head, mv[off:off + step]))
+    # cost ledger: the stream completed — these bytes were serialized FOR a
+    # peer on this tenant's behalf; attribute the work, don't lose it
+    if model_id is not None:
+        LEDGER.note_peer_served(str(model_id), meta["wire_bytes"])
     yield bytes([FRAME_END]) + json.dumps(
         {"chunks": len(entry.chunks), "wire_bytes": meta["wire_bytes"]}
     ).encode()

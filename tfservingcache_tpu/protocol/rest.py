@@ -29,6 +29,7 @@ from aiohttp import web
 
 from tfservingcache_tpu.cluster.status import STATUS_HEADER, STATUS_WANT_HEADER
 from tfservingcache_tpu.protocol.backend import BackendError, RestResponse, ServingBackend
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
@@ -105,6 +106,7 @@ class RestServingServer:
         metrics_path: str | None = None,
         max_body_bytes: int = 256 << 20,
         metrics_scrape_targets: list[str] | None = None,
+        metrics_sum_counters: bool = False,
     ) -> None:
         self.backend = backend
         self.metrics = metrics
@@ -115,6 +117,9 @@ class RestServingServer:
         # extra text-format exporters folded into /metrics (reference
         # MetricsHandler scrape-merge, pkg/taskhandler/metrics.go:16-53)
         self.metrics_scrape_targets = metrics_scrape_targets or []
+        # series-level counter summing across merge sources (per-tenant
+        # fleet aggregation; config metrics.scrape_sum_counters)
+        self.metrics_sum_counters = bool(metrics_sum_counters)
         self.app = web.Application(client_max_size=max_body_bytes)
         self.app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._runner: web.AppRunner | None = None
@@ -133,12 +138,17 @@ class RestServingServer:
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
         path = request.path
         if self.metrics_path and path == self.metrics_path and self.metrics is not None:
+            # mirror the tenant ledger into the tpusc_tenant_* families at
+            # scrape time (delta-inc; no-op unless model_labels is on) so
+            # the engine hot path never touches prometheus
+            LEDGER.publish(self.metrics)
             body = self.metrics.render()
             if self.metrics_scrape_targets:
                 from tfservingcache_tpu.utils.metrics import scrape_and_merge
 
                 body = await scrape_and_merge(
-                    body, self.metrics_scrape_targets, metrics=self.metrics
+                    body, self.metrics_scrape_targets, metrics=self.metrics,
+                    sum_counters=self.metrics_sum_counters,
                 )
             return web.Response(body=body, content_type="text/plain")
         if path == "/healthz":
@@ -184,6 +194,29 @@ class RestServingServer:
             )
             snap["dumps"] = RECORDER.list_dumps()
             return web.json_response(snap)
+        if path == "/monitoring/tenants":
+            # per-tenant cost ledger (utils/accounting.py): ?top=k keeps the
+            # k most expensive tenants by ?dim= (any DIMENSIONS name;
+            # default dominant share), ?model=name@version filters to one
+            # tenant (model_found marks a typo vs an idle tenant), and
+            # ?reset=1 consumes the reset-on-scrape marks so each scrape
+            # interval reads its own window (default peek, unlike
+            # /monitoring/engine: cost integrals are primarily cumulative)
+            try:
+                top = int(request.query.get("top", "0"))
+            except ValueError:
+                return web.json_response(
+                    {"error": "top must be an integer"}, status=400
+                )
+            reset = request.query.get("reset", "0").lower() in (
+                "1", "true", "yes", "on",
+            )
+            return web.json_response(LEDGER.snapshot(
+                top=max(0, top),
+                dim=request.query.get("dim"),
+                model=request.query.get("model"),
+                reset=reset,
+            ))
         if path == "/monitoring/status":
             if self.status_collector is None:
                 return web.json_response(

@@ -49,6 +49,7 @@ from tfservingcache_tpu.runtime.base import (
     RuntimeError_,
 )
 from tfservingcache_tpu.types import ModelId
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
@@ -625,6 +626,17 @@ class GenerateCoalescer:
         the fused generate program), respond = scatter back to rows."""
         end_t = time.monotonic()
         rows = sum(sl.ids.shape[0] for sl in slots)
+        # cost ledger: the batched device call's wall time lands on this
+        # tenant as decode (prefill is fused into the generate program and
+        # not separable); tokens_out excludes the padded-step waste.
+        LEDGER.note_step(
+            str(model_id), "coalesce",
+            decode_s=max(0.0, dev_t1 - dev_t0),
+            tokens_in=sum(
+                sl.ids.shape[0] * sl.ids.shape[1] for sl in slots
+            ),
+            tokens_out=max(0, rows * _next_bucket(batch_max_new) - wasted),
+        )
         RECORDER.record(
             str(model_id), "coalesce",
             step_ms=(dev_t1 - dev_t0) * 1e3,
@@ -771,6 +783,8 @@ class _ContinuousScheduler:
         admitted_n = 0
         retired_n = 0
         prefix_hits_n = 0
+        prefill_s_sum = 0.0
+        tokens_in_n = 0
         while free:
             with self.cv:
                 if not self.pending:
@@ -902,6 +916,8 @@ class _ContinuousScheduler:
             eng.admitted += 1
             admitted_any = True
             admitted_n += 1
+            prefill_s_sum += req.prefill_s
+            tokens_in_n += p
             if hit:
                 prefix_hits_n += 1
                 if eng.metrics is not None:
@@ -960,7 +976,7 @@ class _ContinuousScheduler:
                 # first token): still a ring entry, with no chunk dispatched
                 self._record_step(
                     state, 0, 0, admitted_n, retired_n, 0, step_t0,
-                    prefix_hits_n,
+                    prefix_hits_n, prefill_s_sum, tokens_in_n,
                 )
             return state
         # chunk clamped to the pow2 cover of the largest remaining budget:
@@ -1017,13 +1033,13 @@ class _ContinuousScheduler:
         self._update_page_gauge(state)
         self._record_step(
             state, chunk, active_rows, admitted_n, retired_n, wasted, step_t0,
-            prefix_hits_n,
+            prefix_hits_n, prefill_s_sum, tokens_in_n,
         )
         return state
 
     def _record_step(
         self, state, chunk, active, admitted, retired, wasted, step_t0,
-        prefix_hits=0,
+        prefix_hits=0, prefill_s=0.0, tokens_in=0,
     ) -> None:
         """One flight-recorder ring entry per chunk boundary, plus the
         oldest-queued-age gauge (`gen_admission_wait` only observes at
@@ -1045,9 +1061,23 @@ class _ContinuousScheduler:
         shared = 0
         if paged and hasattr(state, "page_stats"):
             shared = state.page_stats()["shared"]
+        now = time.monotonic()
+        # cost ledger: the whole boundary's wall time lands on this tenant
+        # (each scheduler thread is single-model); the prefill clock sum is
+        # carved out, the remainder is decode+bookkeeping. tokens_out = one
+        # prefill token per admission + the chunk tokens that reached live
+        # rows (wasted overshoot excluded — waste is the ENGINE's cost).
+        LEDGER.note_step(
+            str(self.model_id), "continuous",
+            prefill_s=prefill_s,
+            decode_s=max(0.0, (now - step_t0) - prefill_s),
+            tokens_in=tokens_in,
+            tokens_out=admitted + max(0, active * chunk - wasted),
+            queue_depth=depth,
+        )
         RECORDER.record(
             str(self.model_id), "continuous",
-            step_ms=(time.monotonic() - step_t0) * 1e3,
+            step_ms=(now - step_t0) * 1e3,
             chunk=chunk, active=active, admitted=admitted, retired=retired,
             pages_used=(
                 state.arena_pages - len(state.free_pages) if paged else 0
@@ -1180,6 +1210,12 @@ class ContinuousGenerateEngine:
             total_sum = sum(t for _, t, _ in self._pages.values())
             shared_sum = sum(s for _, _, s in self._pages.values())
         peak = RECORDER.observe_watermark("gen_kv_pages_used", float(used_sum))
+        # cost ledger: this tenant's distinct-page level (feeds its
+        # kv_page_seconds integral) and the cross-model arena occupancy
+        # level (the conservation test's reference integral) — stamped at
+        # the same boundary so Σ tenants tracks the arena exactly
+        LEDGER.gauge_set(str(model_id), "kv_pages", used)
+        LEDGER.note_arena(used_sum)
         if self.metrics is not None:
             self.metrics.gen_kv_pages_used.set(used_sum)
             self.metrics.gen_kv_pages_total.set(total_sum)

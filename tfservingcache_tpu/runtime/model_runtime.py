@@ -35,6 +35,7 @@ from tfservingcache_tpu.config import ServingConfig
 from tfservingcache_tpu.models.registry import ModelDef, TensorSpec, load_artifact
 from tfservingcache_tpu.runtime.base import BaseRuntime, ModelNotLoadedError, RuntimeError_
 from tfservingcache_tpu.types import Model, ModelId, ModelState
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.lockcheck import lockchecked
 from tfservingcache_tpu.utils.logging import get_logger
@@ -956,7 +957,7 @@ class TPUModelRuntime(BaseRuntime):
             self.metrics.compile_duration.labels(
                 self.metrics.model_label(mid.name, mid.version)
             ).observe(dt)
-            self._update_gauges()
+        self._update_gauges()
         log.info(
             "promoted %s from host tier in %.3fs (%d HBM bytes)", mid, dt, hbm
         )
@@ -1158,7 +1159,7 @@ class TPUModelRuntime(BaseRuntime):
             self.metrics.compile_duration.labels(
                 self.metrics.model_label(mid.name, mid.version)
             ).observe(dt)
-            self._update_gauges()
+        self._update_gauges()
         log.info("loaded %s in %.2fs (%d HBM bytes)", mid, dt, hbm)
 
     def _warmup(self, loaded: LoadedModel, compiled: Any = None) -> None:
@@ -2167,7 +2168,7 @@ class TPUModelRuntime(BaseRuntime):
                 del self._load_locks[model_id]
         if self.metrics is not None:
             self.metrics.evictions.labels("hbm").inc()
-            self._update_gauges()
+        self._update_gauges()
         log.info("unloaded %s (freed %d HBM bytes)", model_id, entry.size_bytes)
 
     def unload(self, model_id: ModelId) -> None:
@@ -2573,6 +2574,17 @@ class TPUModelRuntime(BaseRuntime):
             self._spec_health.clear()
 
     def _update_gauges(self) -> None:
+        # cost ledger: re-stamp every resident tenant's HBM level (and zero
+        # the just-evicted — gauge_sync's owner-scoped sweep). Loads/evicts
+        # are rare, so the O(resident) walk is off every request path.
+        LEDGER.gauge_sync(
+            "hbm_bytes",
+            {
+                str(mid): float(e.size_bytes)
+                for mid, e in self._resident.items_lru_first()
+            },
+            owner=f"hbm:{id(self)}",
+        )
         peak = RECORDER.observe_watermark(
             f"hbm_bytes:g{self.group}", float(self._resident.total_bytes)
         )

@@ -20,6 +20,7 @@ from tfservingcache_tpu.config import Config
 from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
 from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
 from tfservingcache_tpu.protocol.rest import RestServingServer
+from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
 from tfservingcache_tpu.utils.logging import get_logger
 from tfservingcache_tpu.utils.metrics import Metrics
@@ -54,7 +55,10 @@ class CacheNode:
 
     def __init__(self, cfg: Config, runtime=None) -> None:
         self.cfg = cfg
-        self.metrics = Metrics(model_labels=cfg.metrics.model_labels)
+        self.metrics = Metrics(
+            model_labels=cfg.metrics.model_labels,
+            max_model_labels=cfg.metrics.max_model_labels,
+        )
         provider = create_provider(cfg.model_provider)
         if cfg.cluster.peer_fetch:
             # peer param distribution: front the store with the peer path
@@ -185,6 +189,7 @@ class CacheNode:
                 require_version=False,
                 metrics_path=cfg.metrics.path if pos == 0 else None,
                 metrics_scrape_targets=cfg.metrics.scrape_targets,
+                metrics_sum_counters=cfg.metrics.scrape_sum_counters,
             )
             grpc = GrpcServingServer(
                 backend, self.metrics, cfg.proxy.grpc_max_message_bytes
@@ -210,6 +215,7 @@ class CacheNode:
                     byte_cap=cfg.cluster.status_byte_cap,
                     max_models=cfg.cluster.status_max_models,
                     min_interval_s=cfg.cluster.status_min_interval_s,
+                    max_tenants=cfg.cluster.status_max_tenants,
                 )
                 rest.status_collector = group.status
                 grpc.status_collector = group.status
@@ -301,6 +307,15 @@ async def serve(cfg: Config) -> None:
         dump_cooldown_s=cfg.observability.dump_cooldown_s,
     )
     RECORDER.install_slow_hook(TRACER)
+    # per-tenant cost-attribution ledger (utils/accounting.py): the engine,
+    # runtime, and cache tiers feed the process-global LEDGER; the knobs
+    # here only tune the noisy-neighbor detector and the master switch
+    LEDGER.configure(
+        enabled=cfg.observability.tenant_accounting,
+        noisy_share=cfg.observability.noisy_neighbor_share,
+        noisy_window_s=cfg.observability.noisy_neighbor_window_s,
+        noisy_min_step_s=cfg.observability.noisy_neighbor_min_step_s,
+    )
     node = CacheNode(cfg)
     rest_port, grpc_port = await node.start()
     log.info(

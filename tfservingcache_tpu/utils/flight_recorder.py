@@ -79,6 +79,23 @@ DEFAULT_RING_ENTRIES = 4096
 _PHASE_NOTES_PER_MODEL = 64
 
 
+def _step_dict(e: tuple) -> dict[str, Any]:
+    """One ring tuple -> the serialization dict. record() always writes
+    full-width tuples, so the common case is a literal build (~3x faster
+    than dict(zip) — snapshot() materializes tail*models of these and is
+    budgeted at < 5 ms for 128 tenant rings); short tuples (deserialized
+    from pre-ISSUE-9 dumps) fall back to zip."""
+    if len(e) == 14:
+        return {
+            "t_wall": e[0], "engine": e[1], "step_ms": e[2], "chunk": e[3],
+            "active": e[4], "admitted": e[5], "retired": e[6],
+            "pages_used": e[7], "pages_free": e[8], "wasted": e[9],
+            "queue_depth": e[10], "oldest_wait_ms": e[11],
+            "pages_shared": e[12], "prefix_hits": e[13],
+        }
+    return dict(zip(STEP_FIELDS, e))
+
+
 class _Ring:
     """Lock-free fixed-size ring of step tuples: one writer-side atomic
     counter hands out slots, so concurrent writers (coalescer leaders of
@@ -97,16 +114,23 @@ class _Ring:
         self.written = i + 1
 
     def tail(self, n: int) -> list[tuple]:
-        """Last ``n`` records, oldest first."""
+        """Last ``n`` records, oldest first. Copies only the requested
+        window (one or two list slices), not the whole ring: with 128
+        tenant rings a full-buffer copy per ring put engine_stats() and
+        snapshot() at ~milliseconds each (guarded at < 5 ms total by
+        tests/test_flight_recorder.py). Slices are GIL-atomic reference
+        copies; a concurrent writer costs at most one misordered row."""
         w = self.written
-        buf = list(self.buf)  # snapshot (GIL-atomic copy of references)
         n = max(0, min(n, w, self.entries))
-        out = []
-        for i in range(w - n, w):
-            rec = buf[i % self.entries]
-            if rec is not None:
-                out.append(rec)
-        return out
+        if n == 0:
+            return []
+        start = (w - n) % self.entries
+        stop = w % self.entries
+        if start >= stop:  # window wraps (or spans the full ring)
+            part = self.buf[start:] + self.buf[:stop]
+        else:
+            part = self.buf[start:stop]
+        return [rec for rec in part if rec is not None]
 
 
 @lockchecked
@@ -248,26 +272,40 @@ class FlightRecorder:
         """Aggregate a step window: goodput = useful / total computed
         step-slots (useful = active*chunk - wasted), the one-number answer
         to "is the engine's compute going to live requests"."""
-        total = sum(e[4] * e[3] for e in entries)       # active * chunk
-        wasted = sum(e[9] for e in entries)
-        admitted = sum(e[5] for e in entries)
-        # appended fields may be absent in entries deserialized from old
-        # dumps — treat short tuples as zero, same as a dense engine
-        hits = sum(e[13] for e in entries if len(e) > 13)
+        # single pass (not one generator sweep per aggregate): this runs
+        # per model per snapshot, so at 128 tenant rings the constant matters
+        total = wasted = admitted = hits = 0
+        step_ms = 0.0
+        max_depth = 0
+        max_wait = 0.0
+        max_shared = 0
+        for e in entries:
+            total += e[4] * e[3]                        # active * chunk
+            wasted += e[9]
+            admitted += e[5]
+            step_ms += e[2]
+            if e[10] > max_depth:
+                max_depth = e[10]
+            if e[11] > max_wait:
+                max_wait = e[11]
+            # appended fields may be absent in entries deserialized from old
+            # dumps — treat short tuples as zero, same as a dense engine
+            if len(e) > 12 and e[12] > max_shared:
+                max_shared = e[12]
+            if len(e) > 13:
+                hits += e[13]
         return {
             "steps": len(entries),
             "step_slots": total,
             "wasted_steps": wasted,
             "goodput": round((total - wasted) / total, 6) if total else 1.0,
-            "step_ms_sum": round(sum(e[2] for e in entries), 3),
-            "max_queue_depth": max((e[10] for e in entries), default=0),
-            "max_oldest_wait_ms": max((e[11] for e in entries), default=0.0),
+            "step_ms_sum": round(step_ms, 3),
+            "max_queue_depth": max_depth,
+            "max_oldest_wait_ms": max_wait,
             "admitted": admitted,
             "prefix_hits": hits,
             "prefix_hit_rate": round(hits / admitted, 6) if admitted else 0.0,
-            "max_pages_shared": max(
-                (e[12] for e in entries if len(e) > 12), default=0
-            ),
+            "max_pages_shared": max_shared,
         }
 
     def engine_stats(self, tail: int = 32) -> dict[str, float]:
@@ -285,8 +323,9 @@ class FlightRecorder:
             entries = ring.tail(tail)
             if not entries:
                 continue
-            total += sum(e[4] * e[3] for e in entries)   # active * chunk
-            wasted += sum(e[9] for e in entries)
+            for e in entries:
+                total += e[4] * e[3]                     # active * chunk
+                wasted += e[9]
             last = entries[-1]
             depth += last[10]
             wait_ms = max(wait_ms, last[11])
@@ -301,32 +340,49 @@ class FlightRecorder:
         tail: int = 64,
         reset_watermarks: bool = False,
         model: str | None = None,
+        row_budget: int | None = 2048,
     ) -> dict[str, Any]:
         """JSON-ready engine state: per-model step window + aggregates,
         phase notes, watermarks. The ``/monitoring/engine`` payload.
         ``model`` (the "name@version" ring key) restricts the per-model
         sections to one tenant — the multi-tenant ?model= filter; an
-        unknown model yields empty sections, not an error."""
+        unknown model yields empty sections plus an explicit
+        ``model_found: false`` marker (tools/engine_dump.py renders it), so
+        a typo'd tenant is distinguishable from a quiet engine.
+
+        ``row_budget`` caps the TOTAL step rows materialized across models:
+        past budget/tail tenants the per-model tail shrinks (floor 8), so a
+        128-tenant node still answers /monitoring/engine in < 5 ms
+        (tests/test_flight_recorder.py) instead of scaling the payload —
+        and the work — linearly with tenant count. Anomaly dumps pass
+        ``row_budget=None``: a postmortem wants the full rings."""
         with self._lock:
             rings = dict(self._rings)
             phases = {m: list(dq) for m, dq in self._phases.items()}
+        found = model is None or model in rings or model in phases
         if model is not None:
             rings = {m: r for m, r in rings.items() if m == model}
             phases = {m: p for m, p in phases.items() if m == model}
+        if row_budget is not None and rings:
+            tail = max(8, min(tail, row_budget // len(rings)))
         models: dict[str, Any] = {}
-        for model, ring in rings.items():
+        for name, ring in rings.items():
             entries = ring.tail(tail)
-            models[model] = {
+            models[name] = {
                 "recorded_steps": ring.written,
                 "window": self._window(entries),
-                "steps": [dict(zip(STEP_FIELDS, e)) for e in entries],
+                "steps": [_step_dict(e) for e in entries],
             }
-        return {
+        out: dict[str, Any] = {
             "ring_entries": self.ring_entries,
             "models": models,
             "phases": phases,
             "watermarks": self.watermarks(reset=reset_watermarks),
         }
+        if model is not None:
+            out["model_filter"] = model
+            out["model_found"] = found
+        return out
 
     # -- anomaly dumps -------------------------------------------------------
     def dump(
@@ -356,7 +412,7 @@ class FlightRecorder:
                 self._last_dump[cool_key] = now
             seq = next(self._dump_seq)
         try:
-            payload = self.snapshot(tail=self.ring_entries)
+            payload = self.snapshot(tail=self.ring_entries, row_budget=None)
             payload.update(
                 reason=reason,
                 model=model or "",

@@ -20,15 +20,29 @@ from prometheus_client import (
 )
 
 ALL_MODELS = "all_models"
+# Cardinality-overflow bucket: once max_model_labels distinct name:version
+# values exist, NEW tenants fold here so a 1000-tenant churn cannot explode
+# every {model=...} family. Established labels keep resolving.
+OTHER_MODELS = "__other__"
+DEFAULT_MAX_MODEL_LABELS = 512
 
 
 class Metrics:
     """One instance per process; injected (no promauto-style globals so tests
     can build many nodes in-process without collisions)."""
 
-    def __init__(self, model_labels: bool = False) -> None:
+    def __init__(
+        self,
+        model_labels: bool = False,
+        max_model_labels: int = DEFAULT_MAX_MODEL_LABELS,
+    ) -> None:
         self.registry = CollectorRegistry()
         self.model_labels = model_labels
+        self.max_model_labels = max(1, int(max_model_labels))
+        # distinct labels handed out; set.add is GIL-atomic, so a racy
+        # concurrent first-sighting can overshoot the cap by a label or two
+        # — acceptable, the cap bounds growth, it is not a hard quota
+        self._seen_model_labels: set[str] = set()
         r = self.registry
         # Exposed names match the reference exactly (prometheus_client appends
         # "_total" to counters, so the constructor names omit it):
@@ -388,11 +402,66 @@ class Metrics:
             "round; spec_tokens+1 = every proposal accepted)",
             registry=r,
         )
+        # per-tenant cost attribution (utils/accounting.py TenantLedger):
+        # the ledger's monotonic integrals mirrored at scrape time via
+        # LEDGER.publish() — series appear only when metrics.model_labels
+        # is on (per-tenant cost without a model label is meaningless).
+        # TPUSC004: family construction stays in this module.
+        self.tenant_tokens = Counter(
+            "tpusc_tenant_tokens",
+            "Tokens attributed to this tenant (direction = in, prompt "
+            "tokens admitted | out, tokens emitted)",
+            ["model", "direction"], registry=r,
+        )
+        self.tenant_step_seconds = Counter(
+            "tpusc_tenant_step_seconds",
+            "Engine wall seconds spent on this tenant's rows "
+            "(phase = prefill | decode); each scheduler dispatch is "
+            "single-model, so step time lands wholly on its tenant",
+            ["model", "phase"], registry=r,
+        )
+        self.tenant_kv_page_seconds = Counter(
+            "tpusc_tenant_kv_page_seconds",
+            "Integral of DISTINCT KV arena pages held by this tenant over "
+            "time (a shared-prefix page counts once, per page_stats())",
+            ["model"], registry=r,
+        )
+        self.tenant_byte_seconds = Counter(
+            "tpusc_tenant_byte_seconds",
+            "Integral of this tenant's residency bytes over time by tier "
+            "(tier = hbm | host | disk)",
+            ["model", "tier"], registry=r,
+        )
+        self.tenant_cold_load_seconds = Counter(
+            "tpusc_tenant_cold_load_seconds",
+            "Wall seconds of ensure_servable resolutions for this tenant "
+            "by serving tier (tier = hbm | host | disk | peer | store)",
+            ["model", "tier"], registry=r,
+        )
+        self.tenant_peer_bytes_served = Counter(
+            "tpusc_tenant_peer_bytes_served",
+            "Packed parameter bytes this node streamed TO peers on the "
+            "tenant's behalf (work done for others, attributed not lost)",
+            ["model"], registry=r,
+        )
+        self.tenant_dominant_share = Gauge(
+            "tpusc_tenant_dominant_share",
+            "Max over dimensions of this tenant's share of the node total "
+            "(DRF-style dominant share in [0,1]; the noisy-neighbor signal)",
+            ["model"], registry=r,
+        )
 
     def model_label(self, name: str, version: int | str) -> str:
-        if self.model_labels:
-            return f"{name}:{version}"
-        return ALL_MODELS
+        if not self.model_labels:
+            return ALL_MODELS
+        label = f"{name}:{version}"
+        seen = self._seen_model_labels
+        if label in seen:
+            return label
+        if len(seen) >= self.max_model_labels:
+            return OTHER_MODELS
+        seen.add(label)
+        return label
 
     def render(self) -> bytes:
         """Text exposition of this registry (served on the metrics path;
@@ -432,11 +501,66 @@ def _emit_families(families, skip: set[str]) -> tuple[list[str], set[str]]:
     return out, emitted
 
 
+def _merge_summed(texts: list[str], on_error) -> bytes:
+    """Series-level merge: one HELP/TYPE per family, counter samples with
+    identical label sets SUMMED across sources, everything else first-source
+    -wins (sources are ordered own-first). This is the fleet-aggregation
+    merge mode: peers exporting per-tenant counter series (model_labels on)
+    combine into fleet totals instead of the first peer shadowing the rest."""
+    from prometheus_client.parser import text_string_to_metric_families
+
+    fams: dict[str, dict] = {}
+    for text in texts:
+        try:
+            parsed = list(text_string_to_metric_families(text))
+        except ValueError as e:
+            on_error(e)
+            continue
+        for fam in parsed:
+            ent = fams.get(fam.name)
+            if ent is None:
+                ent = fams[fam.name] = {
+                    "doc": fam.documentation,
+                    "type": fam.type,
+                    "samples": {},
+                }
+            for s in fam.samples:
+                key = (s.name, tuple(sorted(s.labels.items())))
+                cur = ent["samples"].get(key)
+                if cur is None:
+                    ent["samples"][key] = s.value
+                elif ent["type"] == "counter" and not s.name.endswith("_created"):
+                    ent["samples"][key] = cur + s.value
+                # non-counter duplicates (and _created stamps): first wins
+    out: list[str] = []
+    for name, ent in fams.items():
+        # the parser strips the counter "_total" suffix from the family
+        # name; re-emit it (generate_latest's plain-text convention) so a
+        # re-parse reassociates the _total samples with their family
+        # instead of orphaning them into untyped duplicates
+        ename = name
+        if ent["type"] == "counter" and all(
+            sname.endswith(("_total", "_created"))
+            for sname, _ in ent["samples"]
+        ):
+            ename = name + "_total"
+        out.append(f"# HELP {ename} {_escape_help(ent['doc'])}")
+        out.append(f"# TYPE {ename} {ent['type']}")
+        for (sname, litems), value in ent["samples"].items():
+            labels = ",".join(
+                f'{k}="{_escape_label(str(v))}"' for k, v in litems
+            )
+            label_part = f"{{{labels}}}" if labels else ""
+            out.append(f"{sname}{label_part} {value}")
+    return ("\n".join(out) + "\n").encode()
+
+
 async def scrape_and_merge(
     own: bytes,
     targets: list[str],
     timeout_s: float = 2.0,
     metrics: "Metrics | None" = None,
+    sum_counters: bool = False,
 ) -> bytes:
     """Merge externally-scraped text-format metrics into one exposition.
 
@@ -449,7 +573,13 @@ async def scrape_and_merge(
     parsed and re-emitted with cross-exporter duplicate families dropped
     (own registry wins), and unreachable/corrupt targets are skipped —
     counted in ``tpusc_scrape_errors_total`` and logged at warning, so a
-    degraded merge is visible, not silent."""
+    degraded merge is visible, not silent.
+
+    ``sum_counters`` (config ``metrics.scrape_sum_counters``) switches to a
+    series-level merge: counter samples with identical label sets are
+    SUMMED across own+targets (per-tenant fleet aggregation), other types
+    stay first-source-wins. Default off: the family-level dedup above is
+    byte-stable and cheaper."""
     if not targets:
         return own
     import logging
@@ -475,6 +605,19 @@ async def scrape_and_merge(
         timeout=aiohttp.ClientTimeout(total=timeout_s)
     ) as session:
         bodies = await asyncio.gather(*(fetch(session, url) for url in targets))
+
+    if sum_counters:
+        def _on_parse_error(e: Exception) -> None:
+            logging.getLogger("tpusc.metrics").warning(
+                "metrics merge source unparseable: %s", e
+            )
+            if metrics is not None:
+                metrics.scrape_errors.inc()
+
+        return _merge_summed(
+            [own.decode()] + [b for b in bodies if b is not None],
+            _on_parse_error,
+        )
 
     seen = {f.name for f in text_string_to_metric_families(own.decode())}
     parts = [own.rstrip(b"\n")]

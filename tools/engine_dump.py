@@ -96,6 +96,13 @@ def render(dump: dict, max_steps: int = 32, out=sys.stdout) -> None:
     w = out.write
     reason = dump.get("reason", "snapshot")
     w(f"=== flight dump: {reason} ===\n")
+    if dump.get("model_filter") and not dump.get("model_found", True):
+        # live snapshot narrowed to a model the node has never recorded:
+        # say so explicitly instead of rendering an empty timeline the
+        # on-call could mistake for "model exists but is idle"
+        w(f"no such model: {dump['model_filter']} "
+          f"(no engine rings or phase notes recorded under that name)\n")
+        return
     if dump.get("model"):
         w(f"model:   {dump['model']}\n")
     ctx = dump.get("context") or {}

@@ -41,7 +41,33 @@ def _fmt_bytes(n: int) -> str:
     return f"{n:.1f}GiB"
 
 
-def render(snap: dict, out=sys.stdout) -> None:
+def render_tenants(snap: dict, out=sys.stdout) -> None:
+    """Fleet-wide per-tenant cost table (snap["tenants"], dominant first)."""
+    w = out.write
+    tenants = snap.get("tenants") or {}
+    if not tenants:
+        w("\nno tenant accounting rows (observability.tenant_accounting off, "
+          "or no traffic yet)\n")
+        return
+    w("\ntenant cost attribution (fleet-wide, dominant share first):\n")
+    w(f"{'tenant':<28} {'dom':>6} {'dim':<18} {'tok in/out':>15} "
+      f"{'step s':>8} {'kv pg·s':>10} {'hbm B·s':>10} {'nodes':>5}\n")
+    for tenant, row in tenants.items():
+        totals = row.get("totals") or {}
+        tok = (f"{totals.get('tokens_in', 0):.0f}"
+               f"/{totals.get('tokens_out', 0):.0f}")
+        step_s = (totals.get("prefill_step_seconds", 0.0)
+                  + totals.get("decode_step_seconds", 0.0))
+        w(
+            f"{tenant:<28} {row.get('dominant_share', 0.0):>6.3f} "
+            f"{row.get('dominant_dim', '-'):<18} {tok:>15} "
+            f"{step_s:>8.2f} {totals.get('kv_page_seconds', 0.0):>10.1f} "
+            f"{_fmt_bytes(totals.get('hbm_byte_seconds', 0.0)):>10} "
+            f"{len(row.get('nodes') or []):>5}\n"
+        )
+
+
+def render(snap: dict, out=sys.stdout, tenants: bool = False) -> None:
     w = out.write
     nodes = snap.get("nodes") or {}
     models = snap.get("models") or {}
@@ -84,6 +110,8 @@ def render(snap: dict, out=sys.stdout) -> None:
                 if peers:
                     parts.append(f"{tier}[{','.join(sorted(peers))}]")
             w(f"  {name:<32} {' '.join(parts) or '(cold everywhere)'}\n")
+    if tenants:
+        render_tenants(snap, out)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -92,6 +120,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--watch", type=float, metavar="SECONDS",
         help="refresh every N seconds (top-style) instead of printing once",
+    )
+    ap.add_argument(
+        "--tenants", action="store_true",
+        help="append the fleet-wide per-tenant cost table "
+             "(see tools/tenant_top.py for the dedicated view)",
     )
     args = ap.parse_args(argv)
     while True:
@@ -105,7 +138,7 @@ def main(argv: list[str] | None = None) -> int:
             continue
         if args.watch:
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
-        render(snap)
+        render(snap, tenants=args.tenants)
         if not args.watch:
             return 0
         sys.stdout.flush()

@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Terminal viewer for per-tenant cost attribution (utils/accounting.py).
+
+Answers "who is expensive" the way capacity review asks it: one row per
+tenant (``name@version``), ordered by DOMINANT SHARE — the tenant's
+fraction of each resource dimension's total, maxed over dimensions (the
+DRF score), so a tenant hogging KV pages ranks high even if its token
+counts look modest.
+
+Two sources, same table:
+
+  - default: a ROUTER's ``GET /monitoring/cluster`` — the fleet view's
+    cross-node aggregation (per-tenant vectors summed over nodes, shares
+    recomputed fleet-wide), plus which nodes reported each tenant;
+  - ``--node``: a single node's ``GET /monitoring/tenants`` — the local
+    ledger, with live gauge levels, reload source mix, and the
+    reset-on-scrape window (always peeks with reset=0).
+
+Usage:
+    python tools/tenant_top.py http://router:8501
+    python tools/tenant_top.py http://node:8501 --node --top 10 --dim kv_page_seconds
+    python tools/tenant_top.py http://router:8501 --watch 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+
+def fetch_cluster(url: str, timeout: float = 5.0) -> dict:
+    full = f"{url.rstrip('/')}/monitoring/cluster"
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def fetch_node(
+    url: str,
+    top: int = 0,
+    dim: str | None = None,
+    model: str | None = None,
+    timeout: float = 5.0,
+) -> dict:
+    """GET <url>/monitoring/tenants with reset=0 — peeking must not
+    consume the node's reset-on-scrape window marks."""
+    query: dict[str, str] = {"reset": "0"}
+    if top:
+        query["top"] = str(top)
+    if dim:
+        query["dim"] = dim
+    if model:
+        query["model"] = model
+    full = f"{url.rstrip('/')}/monitoring/tenants?{urllib.parse.urlencode(query)}"
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _row(tenant: str, totals: dict, share: float, dim: str, extra: str,
+         out) -> None:
+    step_s = (totals.get("prefill_step_seconds", 0.0)
+              + totals.get("decode_step_seconds", 0.0))
+    tok = (f"{totals.get('tokens_in', 0):.0f}"
+           f"/{totals.get('tokens_out', 0):.0f}")
+    out.write(
+        f"{tenant:<28} {share:>6.3f} {dim:<18} {tok:>15} "
+        f"{step_s:>8.2f} {totals.get('kv_page_seconds', 0.0):>10.1f} "
+        f"{_fmt_bytes(totals.get('hbm_byte_seconds', 0.0)):>10} "
+        f"{_fmt_bytes(totals.get('peer_bytes_served', 0.0)):>9} "
+        f"{extra}\n"
+    )
+
+
+_HEADER = (
+    f"{'tenant':<28} {'dom':>6} {'dim':<18} {'tok in/out':>15} "
+    f"{'step s':>8} {'kv pg·s':>10} {'hbm B·s':>10} {'peer B':>9}"
+)
+
+
+def render_fleet(snap: dict, out=sys.stdout) -> None:
+    """Render a /monitoring/cluster payload's ``tenants`` aggregation."""
+    w = out.write
+    tenants = snap.get("tenants") or {}
+    w(f"=== fleet tenants: {len(tenants)} reported "
+      f"across {len(snap.get('nodes') or {})} peers ===\n")
+    if not tenants:
+        w("no tenant accounting rows (observability.tenant_accounting off, "
+          "or no traffic yet)\n")
+        return
+    w(_HEADER + f" {'nodes':>5}\n")
+    for tenant, row in tenants.items():
+        _row(
+            tenant, row.get("totals") or {},
+            row.get("dominant_share", 0.0), row.get("dominant_dim", "-"),
+            f"{len(row.get('nodes') or []):>5}", out,
+        )
+
+
+def render_node(snap: dict, out=sys.stdout) -> None:
+    """Render a /monitoring/tenants payload (single node's ledger)."""
+    w = out.write
+    if snap.get("model_filter") and not snap.get("model_found", True):
+        w(f"no such tenant: {snap['model_filter']} "
+          f"(never recorded by this node's ledger)\n")
+        return
+    tenants = snap.get("tenants") or {}
+    w(f"=== node tenants: {len(tenants)} shown, "
+      f"arena integral {snap.get('arena_page_seconds', 0.0):.1f} pg·s ===\n")
+    if not tenants:
+        w("no tenant accounting rows (observability.tenant_accounting off, "
+          "or no traffic yet)\n")
+        return
+    w(_HEADER + f" {'cold s':>7}\n")
+    for tenant in snap.get("top") or list(tenants):
+        row = tenants.get(tenant) or {}
+        totals = row.get("totals") or {}
+        _row(
+            tenant, totals,
+            row.get("dominant_share", 0.0), row.get("dominant_dim", "-"),
+            f"{totals.get('cold_load_seconds', 0.0):>7.2f}", out,
+        )
+        gauges = row.get("gauges") or {}
+        if gauges:
+            live = " ".join(f"{g}={gauges[g]:.0f}" for g in sorted(gauges))
+            w(f"  live: {live}\n")
+        loads = row.get("loads") or {}
+        if loads:
+            mix = " ".join(
+                f"{tier}[{loads[tier].get('count', 0)}x "
+                f"{loads[tier].get('seconds', 0.0):.2f}s]"
+                for tier in sorted(loads)
+            )
+            w(f"  reloads: {mix}\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", help="router (default) or node (--node) base URL")
+    ap.add_argument(
+        "--node", action="store_true",
+        help="read one node's /monitoring/tenants instead of the router's "
+             "fleet-wide /monitoring/cluster aggregation",
+    )
+    ap.add_argument(
+        "--top", type=int, default=0,
+        help="with --node: keep only the k highest tenants",
+    )
+    ap.add_argument(
+        "--dim",
+        help="with --node: rank by this dimension instead of dominant share "
+             "(e.g. kv_page_seconds, hbm_byte_seconds)",
+    )
+    ap.add_argument(
+        "--model",
+        help="with --node: restrict to one tenant (name@version)",
+    )
+    ap.add_argument(
+        "--watch", type=float, metavar="SECONDS",
+        help="refresh every N seconds (top-style) instead of printing once",
+    )
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            if args.node:
+                snap = fetch_node(
+                    args.url, top=args.top, dim=args.dim, model=args.model
+                )
+            else:
+                snap = fetch_cluster(args.url)
+        except Exception as e:  # noqa: BLE001 — CLI surface: report and retry/exit
+            endpoint = "tenants" if args.node else "cluster"
+            print(f"fetch {args.url}/monitoring/{endpoint} failed: {e}",
+                  file=sys.stderr)
+            if not args.watch:
+                return 1
+            time.sleep(args.watch)
+            continue
+        if args.watch:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+        if args.node:
+            render_node(snap)
+        else:
+            render_fleet(snap)
+        if not args.watch:
+            return 0
+        sys.stdout.flush()
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
