@@ -278,7 +278,7 @@ SECTION_GROUPS = (
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
     "warm_tier", "peer_cold_start", "cold_pipeline", "paged_kv",
-    "shared_prefix",
+    "shared_prefix", "paged_kernel",
 )
 
 
@@ -2311,6 +2311,182 @@ def bench_shared_prefix(tmp: str, lm_config: dict) -> dict:
     return out
 
 
+def bench_paged_kernel(tmp: str, lm_config: dict) -> dict:
+    """Paged-attention decode dispatch A/B at a MATCHED arena byte budget
+    on the same seeded Poisson swarm as `paged_kv`: gather+einsum reference
+    (serving.kv_paged_kernel=false), fused Pallas kernel, and the kernel
+    over an int8 arena whose page count is grown to fill the identical
+    byte budget (the capacity arm). Reported per arm: decode tok/s at 16
+    lanes (the ISSUE 14 speed headline — chip evidence only; on CPU the
+    kernel arm's dispatch gate falls through to the reference, recorded as
+    kernel_active=false), peak admitted slots (the int8 capacity
+    headline), and a deterministic greedy top-1 agreement probe for the
+    int8 arm (cascade-aware: once a row's token flips, later steps are no
+    longer the same decision)."""
+    import threading
+
+    import numpy as np
+
+    from tfservingcache_tpu.ops.attention import TPU_BACKENDS
+    from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    manager, runtime = _make_stack("transformer_lm", 1, tmp, config=lm_config)
+    mid = ModelId("tenant0", 1)
+    manager.ensure_servable(mid)
+
+    slots, chunk = 16, 4
+    page_tokens = 16
+    # the bf16 arena is deliberately admission-GATING (~half the lanes'
+    # worth of live pages at ~3 pages per request): the int8 arm's extra
+    # pages at the same byte budget must show up as admitted slots, not
+    # vanish into free-list headroom
+    arena_pages = 26
+    head_dim = lm_config["d_model"] // lm_config["n_heads"]
+    dense_item = np.dtype(lm_config.get("dtype", "float32")).itemsize
+    # same byte budget re-cut as int8 rows (hd payload + one f32 scale)
+    int8_pages = arena_pages * head_dim * dense_item // (head_dim + 4)
+
+    import jax
+
+    backend = jax.default_backend()
+    kernel_active = backend in TPU_BACKENDS and head_dim % 64 == 0
+
+    n_req = 24
+    vocab = lm_config["vocab_size"]
+    r = np.random.default_rng(42)
+    reqs = [
+        (
+            r.integers(0, vocab, int(r.integers(8, 17))).astype(np.int32),
+            int(r.integers(16, 34)),
+        )
+        for _ in range(n_req)
+    ]
+    arrivals = np.cumsum(r.exponential(0.02, n_req))
+
+    def replay(gen_fn) -> tuple[list, float]:
+        results: list = [None] * n_req
+        errors: list = []
+
+        def client(i):
+            prompt, max_new = reqs[i]
+            try:
+                results[i] = gen_fn(prompt, max_new)
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        start = time.perf_counter()
+        for i in range(n_req):
+            delay = arrivals[i] - (time.perf_counter() - start)
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        if errors:
+            raise RuntimeError(f"{len(errors)} failed: {errors[:3]}")
+        return results, wall
+
+    probe = np.stack([
+        np.concatenate([
+            r.integers(1, vocab, 12).astype(np.int32), np.zeros(4, np.int32)
+        ])
+        for _ in range(4)
+    ])
+    probe_tokens = {}
+
+    def run_arm(name: str, **engine_kw) -> dict:
+        metrics = Metrics()
+        eng = ContinuousGenerateEngine(
+            runtime, slots=slots, chunk_tokens=chunk, metrics=metrics,
+            page_tokens=page_tokens, **engine_kw
+        )
+        try:
+            # warm BOTH prompt buckets' prefill/insert programs plus the
+            # decode-chunk program off-window — the prefill jits are shared
+            # across arms via the runtime's cache, so an arm that skipped a
+            # bucket here would gift its compile to the measured window of
+            # whichever arm ran first (pure ordering artifact)
+            eng.generate(mid, np.ones((1, 16), np.int32), max_new_tokens=4)
+            eng.generate(mid, np.ones((1, 8), np.int32), max_new_tokens=4)
+            eng.peak_active = 0
+
+            def fn(prompt, max_new):
+                _, stats = eng.generate(
+                    mid, prompt[None], max_new_tokens=max_new,
+                    return_stats=True,
+                )
+                return stats[0]["ttft_s"], stats[0]["tokens"]
+
+            results, wall = replay(fn)
+            # deterministic greedy probe for the cross-arm agreement check
+            probe_tokens[name] = eng.generate(
+                mid, probe, prompt_lengths=[12] * 4, max_new_tokens=8
+            )
+            ttfts = sorted(t for t, _ in results)
+            toks = sum(n for _, n in results)
+            st = runtime._slot_states[mid]
+            st.check_page_conservation()
+            arena_bytes = int(st.k.nbytes) + int(st.v.nbytes)
+            if st.scales is not None:
+                arena_bytes += sum(int(a.nbytes) for a in st.scales.values())
+            return {
+                "peak_admitted_slots": eng.peak_active,
+                "p50_ttft_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+                "tok_s": round(toks / wall, 1),
+                "wall_s": round(wall, 2),
+                "tokens": toks,
+                "arena_pages": st.arena_pages,
+                "arena_bytes": arena_bytes,
+                "conservation_ok": True,
+            }
+        finally:
+            eng.close()
+            runtime.drop_slot_state(mid)  # next arm allocates its own layout
+
+    out = {
+        "requests": n_req,
+        "slots": slots,
+        "page_tokens": page_tokens,
+        "backend": backend,
+        "kernel_active": kernel_active,
+        "gather_einsum": run_arm("gather_einsum", arena_pages=arena_pages,
+                                 paged_kernel=False),
+        "kernel": run_arm("kernel", arena_pages=arena_pages,
+                          paged_kernel=True),
+        "kernel_int8": run_arm("kernel_int8", arena_pages=int8_pages,
+                               paged_kernel=True, arena_dtype="int8"),
+    }
+    out["tok_s_ratio_kernel"] = round(
+        out["kernel"]["tok_s"] / max(1e-9, out["gather_einsum"]["tok_s"]), 2
+    )
+    out["admitted_slots_ratio_int8"] = round(
+        out["kernel_int8"]["peak_admitted_slots"]
+        / max(1, out["gather_einsum"]["peak_admitted_slots"]), 2
+    )
+    eq = probe_tokens["gather_einsum"] == probe_tokens["kernel_int8"]
+    agree = total = 0
+    for row in eq:
+        if row.all():
+            agree += row.size
+            total += row.size
+        else:
+            first = int(np.argmin(row))
+            agree += first
+            total += first + 1
+    out["int8_top1_agreement"] = round(agree / max(1, total), 4)
+    out["kernel_greedy_match"] = bool(
+        (probe_tokens["gather_einsum"] == probe_tokens["kernel"]).all()
+    )
+    manager.close()
+    return out
+
+
 def watcher_liveness() -> dict:
     """Probe-history summary from the watcher's state file + log, embedded
     into EVERY bench artifact — even a CPU-fallback run self-reports whether
@@ -2375,7 +2551,7 @@ def collect_watcher_evidence() -> dict:
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
         "continuous_batching", "zoo_cold", "warm_tier", "cold_pipeline",
-        "paged_kv", "shared_prefix",
+        "paged_kv", "shared_prefix", "paged_kernel",
         "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
@@ -2713,6 +2889,15 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["shared_prefix"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("paged_kernel"):
+        try:
+            with _section("paged_kernel"):
+                detail["paged_kernel"] = bench_paged_kernel(
+                    os.path.join(tmp, "pagedkernel"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["paged_kernel"] = {"error": f"{type(e).__name__}: {e}"}
 
     _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):

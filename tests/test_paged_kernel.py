@@ -1,0 +1,283 @@
+"""Fused Pallas paged-attention decode kernel (`serving.kv_paged_kernel`):
+interpret-mode kernel-vs-reference parity (ragged pos, page_tokens in
+{8,16}, GQA groups in {1,4}, int8 arenas), byte-for-byte reference
+dispatch with the knob off, greedy token-for-token parity kernel-on vs
+kernel-off through the continuous engine, and the hardware-gated
+`paged_decode` entries tools/tpu_kernel_check.py runs on a real chip
+(max-abs-err + bandwidth-proxy timing at S in {4,16,32} lanes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tfservingcache_tpu.models.generation as generation
+import tfservingcache_tpu.ops.attention as att
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.ops.attention import (
+    dequantize_pages,
+    paged_attention,
+    paged_decode_attention,
+    paged_decode_attention_kernel,
+)
+from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import Model, ModelId
+
+# f32 model dtype: the kernel's online softmax and the reference's plain
+# softmax are algebraically identical but round differently in bf16 (the
+# unnormalized-vs-normalized probs differ in the last bf16 bit) — in f32
+# the divergence is ~1e-7 and greedy argmax parity is robust.
+TINY = {
+    "vocab_size": 97,
+    "d_model": 48,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 96,
+    "max_seq": 64,
+    "dtype": "float32",
+}
+
+PT = 8
+
+
+def _arena(lanes, hq, hkv, d, pps, pt, seed=0, dtype=np.float32):
+    """Random scattered arena + ragged pos: every lane's pages land at
+    shuffled arena slots (page 0 stays trash), trailing table slots 0."""
+    rng = np.random.default_rng(seed)
+    n_pages = lanes * pps + 1
+    perm = rng.permutation(np.arange(1, n_pages))
+    tables = perm.reshape(lanes, pps).astype(np.int32)
+    k_pages = rng.standard_normal((n_pages, hkv, pt, d)).astype(dtype)
+    v_pages = rng.standard_normal((n_pages, hkv, pt, d)).astype(dtype)
+    q = rng.standard_normal((lanes, hq, 1, d)).astype(dtype)
+    pos = rng.integers(0, pps * pt, lanes).astype(np.int32)
+    # park table slots past each lane's live pages on trash, as the real
+    # block tables do — the kernel's clamped index map must never read them
+    for s in range(lanes):
+        live = -(-(int(pos[s]) + 1) // pt)
+        tables[s, live:] = 0
+    return q, k_pages, v_pages, tables, pos
+
+
+@pytest.mark.parametrize("pt", [8, 16])
+@pytest.mark.parametrize("g", [1, 4])  # GQA group size hq/hkv
+def test_kernel_matches_reference_interpret(pt, g):
+    """Interpret-mode kernel parity against the gather+einsum reference
+    over scattered pages and ragged pos, at MHA (g=1) and GQA (g=4)."""
+    hkv = 2
+    q, kp, vp, tables, pos = _arena(
+        lanes=5, hq=hkv * g, hkv=hkv, d=16, pps=4, pt=pt, seed=g * 7 + pt
+    )
+    want = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(pos), pt,
+    ))
+    got = np.asarray(paged_decode_attention_kernel(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(pos),
+        page_tokens=pt, interpret=True,
+    ))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_int8_matches_dequantized_reference():
+    """int8 arena: in-kernel dequant must equal the reference run on the
+    explicitly dequantized pages (same scales, same math)."""
+    q, kp, vp, tables, pos = _arena(
+        lanes=4, hq=4, hkv=2, d=16, pps=4, pt=PT, seed=3
+    )
+    kq, ks = generation._quantize_kv_rows(jnp.asarray(kp))
+    vq, vs = generation._quantize_kv_rows(jnp.asarray(vp))
+    want = np.asarray(paged_decode_attention(
+        jnp.asarray(q), dequantize_pages(kq, ks), dequantize_pages(vq, vs),
+        jnp.asarray(tables), jnp.asarray(pos), PT,
+    ))
+    got = np.asarray(paged_decode_attention_kernel(
+        jnp.asarray(q), kq, vq, jnp.asarray(tables), jnp.asarray(pos),
+        ks, vs, page_tokens=PT, interpret=True,
+    ))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+    # and the quantization itself stays within the int8 rounding envelope
+    np.testing.assert_allclose(
+        np.asarray(dequantize_pages(kq, ks)), kp, atol=2e-2, rtol=2e-2
+    )
+
+
+def test_kernel_rejects_bad_shapes():
+    q, kp, vp, tables, pos = _arena(
+        lanes=2, hq=3, hkv=2, d=16, pps=2, pt=PT
+    )
+    with pytest.raises(ValueError, match="multiple"):
+        paged_decode_attention_kernel(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(pos),
+            page_tokens=PT, interpret=True,
+        )
+
+
+def test_dispatch_kernel_off_is_reference_path():
+    """`kernel=False` (serving.kv_paged_kernel=false) must route through
+    paged_decode_attention itself — bitwise identical, not merely close."""
+    q, kp, vp, tables, pos = _arena(
+        lanes=3, hq=4, hkv=2, d=16, pps=4, pt=PT, seed=5
+    )
+    args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(pos))
+    off = np.asarray(paged_attention(*args, PT, kernel=False))
+    ref = np.asarray(paged_decode_attention(*args, PT))
+    assert (off == ref).all()
+    # on CPU the TPU-shape gate also falls back to the reference
+    on_cpu = np.asarray(paged_attention(*args, PT, kernel=True))
+    assert (on_cpu == ref).all()
+
+
+# -- engine-level greedy parity ----------------------------------------------
+
+@pytest.fixture
+def interpret_kernel(monkeypatch):
+    """Force the dispatcher's kernel arm on CPU via interpret mode. The
+    decode-chunk jit reads the flag at trace time, so traces from other
+    tests (or the flag-off arm) must be dropped around the toggle."""
+    generation._paged_decode_chunk_jit.clear_cache()
+    monkeypatch.setattr(att, "PAGED_KERNEL_INTERPRET", True)
+    yield
+    generation._paged_decode_chunk_jit.clear_cache()
+
+
+def _load(tmp_path, name="lm"):
+    export_artifact("transformer_lm", str(tmp_path), name=name, version=1,
+                    config=TINY)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu"))
+    mid = ModelId(name, 1)
+    rt.ensure_loaded(Model(identifier=mid, path=str(tmp_path / name / "1")))
+    return rt, mid
+
+
+def _ragged_prompts(rows=6, width=11, seed=0):
+    rng = np.random.default_rng(seed)
+    lens = list(int(x) for x in rng.integers(2, width + 1, rows))
+    ids = np.zeros((rows, width), np.int32)
+    for b, length in enumerate(lens):
+        ids[b, :length] = rng.integers(1, TINY["vocab_size"], length)
+    return ids, lens
+
+
+def test_greedy_parity_kernel_on_vs_off(tmp_path, interpret_kernel):
+    """Token-for-token greedy parity through the continuous engine:
+    kernel-on (interpret) vs kernel-off must be indistinguishable on
+    ragged prompts, and the arena must drain clean in both arms."""
+    ids, lens = _ragged_prompts()
+    outs = {}
+    for arm, kern in (("off", False), ("on", True)):
+        rt, mid = _load(tmp_path / arm)
+        eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                       page_tokens=PT, arena_pages=32,
+                                       paged_kernel=kern)
+        try:
+            outs[arm] = eng.generate(mid, ids, prompt_lengths=lens,
+                                     max_new_tokens=8)
+            st = rt._slot_states[mid]
+            assert st.kernel is kern
+            st.check_page_conservation()
+        finally:
+            eng.close()
+            rt.close()
+    assert (outs["on"] == outs["off"]).all()
+
+
+# -- hardware-gated proofs (tools/tpu_kernel_check.py `paged_decode`) ---------
+
+@pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="needs real TPU (conftest forces CPU; run via tools/tpu_kernel_check.py)",
+)
+@pytest.mark.parametrize("lanes", [4, 16, 32])
+def test_paged_decode_kernel_on_tpu(lanes):
+    """Hardware proof for the paged decode kernel: Mosaic-compiles, matches
+    the gather+einsum reference, and — at serving occupancy (>=16 lanes) —
+    beats it by the 1.5x the ISSUE 14 acceptance bar demands. The timing
+    ratio is a bandwidth proxy: both sides stream the same live KV bytes,
+    the reference just streams them twice (gather out + einsum in)."""
+    from tfservingcache_tpu.utils.benchtime import chained_device_time
+
+    hq, hkv, d, pt, pps = 8, 8, 128, 16, 64  # 1024-token logical rows
+    q, kp, vp, tables, pos = _arena(
+        lanes, hq, hkv, d, pps, pt, seed=lanes
+    )
+    # long-lived lanes: bandwidth-bound shape, not mask-bound
+    pos = np.full((lanes,), pps * pt - 1, np.int32)
+    tables[:, :] = np.arange(1, lanes * pps + 1).reshape(lanes, pps)
+    q, kp, vp = (jnp.asarray(q, jnp.bfloat16), jnp.asarray(kp, jnp.bfloat16),
+                 jnp.asarray(vp, jnp.bfloat16))
+    tables, pos = jnp.asarray(tables), jnp.asarray(pos)
+
+    out = paged_decode_attention_kernel(
+        q, kp, vp, tables, pos, page_tokens=pt
+    )
+    ref = paged_decode_attention(q, kp, vp, tables, pos, pt)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < 3e-2, f"paged kernel diverges: max abs err {err}"
+
+    t_kern = chained_device_time(
+        lambda q: paged_decode_attention_kernel(
+            q, kp, vp, tables, pos, page_tokens=pt
+        ), (q,)
+    )
+    t_ref = chained_device_time(
+        lambda q: paged_decode_attention(q, kp, vp, tables, pos, pt), (q,)
+    )
+    kv_bytes = 2 * lanes * pps * hkv * pt * d * kp.dtype.itemsize
+    print(
+        f"\n[paged_decode] S={lanes} hq={hq} hkv={hkv} d={d} pt={pt}: "
+        f"kernel {t_kern*1e3:.3f} ms ({kv_bytes/t_kern/1e9:.0f} GB/s proxy), "
+        f"gather+einsum {t_ref*1e3:.3f} ms, speedup {t_ref/t_kern:.2f}x, "
+        f"max_abs_err {err:.4f}",
+        flush=True,
+    )
+    if lanes >= 16:
+        assert t_ref / t_kern >= 1.5, (
+            f"paged kernel speedup {t_ref/t_kern:.2f}x < 1.5x at S={lanes}"
+        )
+
+
+@pytest.mark.skipif(
+    jax.default_backend() not in ("tpu", "axon"),
+    reason="needs real TPU (conftest forces CPU; run via tools/tpu_kernel_check.py)",
+)
+def test_paged_decode_int8_on_tpu():
+    """Hardware proof for the int8 arena: in-kernel dequant Mosaic-compiles
+    and tracks the bf16 kernel within the int8 rounding envelope, at half
+    the streamed KV bytes."""
+    from tfservingcache_tpu.utils.benchtime import chained_device_time
+
+    lanes, hq, hkv, d, pt, pps = 16, 8, 8, 128, 16, 64
+    q, kp, vp, tables, pos = _arena(lanes, hq, hkv, d, pps, pt, seed=2)
+    pos = np.full((lanes,), pps * pt - 1, np.int32)
+    tables[:, :] = np.arange(1, lanes * pps + 1).reshape(lanes, pps)
+    q16 = jnp.asarray(q, jnp.bfloat16)
+    kq, ks = generation._quantize_kv_rows(jnp.asarray(kp))
+    vq, vs = generation._quantize_kv_rows(jnp.asarray(vp))
+    tables, pos = jnp.asarray(tables), jnp.asarray(pos)
+
+    out8 = paged_decode_attention_kernel(
+        q16, kq, vq, tables, pos, ks, vs, page_tokens=pt
+    )
+    out16 = paged_decode_attention_kernel(
+        q16, jnp.asarray(kp, jnp.bfloat16), jnp.asarray(vp, jnp.bfloat16),
+        tables, pos, page_tokens=pt
+    )
+    err = float(jnp.max(jnp.abs(out8 - out16)))
+    assert err < 5e-2, f"int8 kernel diverges from bf16: max abs err {err}"
+    t8 = chained_device_time(
+        lambda q: paged_decode_attention_kernel(
+            q, kq, vq, tables, pos, ks, vs, page_tokens=pt
+        ), (q16,)
+    )
+    print(
+        f"\n[paged_decode int8] S={lanes}: kernel {t8*1e3:.3f} ms, "
+        f"max_abs_err_vs_bf16 {err:.4f}",
+        flush=True,
+    )

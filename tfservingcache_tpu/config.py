@@ -111,6 +111,25 @@ class ServingConfig:
     # lane would write into are copy-on-write; index-held pages are
     # reclaimed under admission pressure before a request is ever blocked.
     kv_share_prefix_bytes: int = 0
+    # Fused Pallas paged-attention decode kernel (ops/attention.py
+    # paged_attention): walk each lane's block table inside the kernel and
+    # compute online-softmax attention straight from the page arena — one
+    # pass over the KV bytes instead of paged_gather_kv's materialized
+    # pages[tables] round-trip. true (default) uses the kernel on TPU
+    # backends when shapes qualify (head_dim % 64 == 0, heads divisible by
+    # kv heads) and falls back to the gather+einsum reference everywhere
+    # else; false forces the reference path unconditionally — byte-for-byte
+    # the pre-kernel behavior, the A/B lever for parity tests and bench.
+    kv_paged_kernel: bool = True
+    # KV page arena element type. "" (default) stores pages in the model's
+    # own dtype. "int8" quantizes pages symmetrically per (page, kv_head,
+    # token) row with f32 scales riding beside the arena — rows dequantize
+    # inside the decode kernel (or before the reference einsum), and the
+    # auto-sized arena (kv_arena_pages == 0) grows to fill the SAME byte
+    # budget the dense arena would have used (~1.9x pages for bf16 models),
+    # which is the capacity win. Page bookkeeping (reserve/CoW/census) is
+    # count-based and identical under quantization.
+    kv_arena_dtype: str = ""
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

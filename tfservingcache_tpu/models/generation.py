@@ -371,31 +371,66 @@ def _decode_chunk_jit(
     return slot_k, slot_v, tok, pos, jnp.transpose(toks, (1, 0))  # (S, chunk)
 
 
-def init_paged_cache(cfg: dict, n_pages: int, page_tokens: int) -> dict:
+def init_paged_cache(cfg: dict, n_pages: int, page_tokens: int,
+                     arena_dtype: str = "") -> dict:
     """Preallocated paged KV arena shared by every lane of one model's
     continuous-decode state: fixed-size pages instead of per-lane
     ``max_seq`` rows, so HBM is sized by tokens in flight, not worst case.
     Page 0 is the TRASH page — never handed out by the free-list; retired
     and never-admitted lanes' block tables point at it so their frozen
-    rewrites land somewhere no live lane ever gathers."""
+    rewrites land somewhere no live lane ever gathers.
+
+    ``arena_dtype="int8"`` (serving.kv_arena_dtype) stores the pages
+    quantized with per-(page, head, token) f32 scales riding in a parallel
+    ``k_scale``/``v_scale`` buffer — one scale per written KV row, so an
+    append never requantizes resident rows (a true per-page scale would
+    force a read-modify-write of the whole page on every decode step).
+    Payload bytes halve vs bf16 (head_dim int8 + 4 scale bytes per row vs
+    2*head_dim), which is where the extra admitted slots come from."""
     n_kv = cfg["n_kv_heads"]
     head_dim = cfg["d_model"] // cfg["n_heads"]
     dtype = jnp.dtype(cfg["dtype"])
     shape = (cfg["n_layers"], n_pages, n_kv, page_tokens, head_dim)
+    if arena_dtype == "int8":
+        sshape = shape[:-1]
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(sshape, jnp.float32),
+            "v_scale": jnp.zeros(sshape, jnp.float32),
+        }
+    if arena_dtype:
+        dtype = jnp.dtype(arena_dtype)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
+def _quantize_kv_rows(x):
+    """Symmetric absmax int8 over the head_dim axis: ``x (..., hd)`` ->
+    (int8 values, f32 scales ``(...)``) with ``x ≈ values * scales[..., None]``.
+    Per-row scales keep quantization LOCAL to the written row — the
+    incremental-append property the arena's write paths depend on."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
-                        page_tokens: int):
+                        page_tokens: int, kernel: bool = False):
     """One decode step (s_len=1 per lane) against the paged arena — the
     block-table counterpart of ``_forward_cached_dyn``. Each lane writes its
     new K/V at ``tables[lane, pos // page_tokens]`` offset ``pos %
     page_tokens`` (clipped to the last table slot: overshoot past a lane's
     reservation hits a zeroed table entry, i.e. the trash page), then
-    attends over its gathered pages with the identical GQA einsum/mask
-    pipeline as the dense path — same shapes, same reduction order, so
-    greedy decode is token-for-token identical."""
-    from tfservingcache_tpu.ops.attention import paged_decode_attention
+    attends over its pages via ``paged_attention`` — the fused Pallas
+    kernel when ``kernel`` and the backend/shape gate admit it, else the
+    gather+einsum reference whose GQA/mask pipeline matches the dense path
+    operation-for-operation, so greedy decode is token-for-token identical.
+
+    An int8 arena (``cache["k_scale"]`` present) quantizes each lane's new
+    row at write time — per-row scales, so resident rows are never
+    requantized — and attention dequantizes on the read side."""
+    from tfservingcache_tpu.ops.attention import paged_attention
 
     dtype = jnp.dtype(cfg["dtype"])
     s_lanes = tok.shape[0]
@@ -407,9 +442,10 @@ def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
         tables, jnp.clip(pos // page_tokens, 0, pps - 1)[:, None], axis=1
     )[:, 0]                                                      # (S,)
     off = pos % page_tokens
+    quantized = "k_scale" in cache
 
     x = params["embed"][tok[:, None]].astype(dtype)              # (S, 1, d)
-    new_k, new_v = [], []
+    new_k, new_v, new_ks, new_vs = [], [], [], []
     for li, layer in enumerate(params["layers"]):
         attn = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["attn"])
         h = _rmsnorm(x, layer["ln1"])
@@ -422,30 +458,45 @@ def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
         # scatter each lane's single new row into its current page; lanes
         # parked on the trash page may collide — last-writer-wins junk that
         # no live lane's block table can reach
+        k_row, v_row = k[:, :, 0, :], v[:, :, 0, :]              # (S, n_kv, hd)
+        ks_arena = vs_arena = None
+        if quantized:
+            k_row, k_s = _quantize_kv_rows(k_row)
+            v_row, v_s = _quantize_kv_rows(v_row)
+            ks_arena = cache["k_scale"][li].at[page, :, off].set(k_s)
+            vs_arena = cache["v_scale"][li].at[page, :, off].set(v_s)
+            new_ks.append(ks_arena)
+            new_vs.append(vs_arena)
         k_arena = cache["k"][li].at[page, :, off, :].set(
-            k[:, :, 0, :].astype(cache["k"].dtype)
+            k_row.astype(cache["k"].dtype)
         )
         v_arena = cache["v"][li].at[page, :, off, :].set(
-            v[:, :, 0, :].astype(cache["v"].dtype)
+            v_row.astype(cache["v"].dtype)
         )
         new_k.append(k_arena)
         new_v.append(v_arena)
 
-        out = paged_decode_attention(q, k_arena, v_arena, tables, pos,
-                                     page_tokens)
+        out = paged_attention(q, k_arena, v_arena, tables, pos, page_tokens,
+                              k_scale=ks_arena, v_scale=vs_arena,
+                              kernel=kernel)
         out = out.reshape(s_lanes, n_heads, 1, head_dim).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(s_lanes, 1, cfg["d_model"])
         x = x + out @ attn["wo"]
         x = x + _ffn_block(layer, x, cfg, family, dtype)
     x = _rmsnorm(x, params["ln_f"])
     logits = (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    new_cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quantized:
+        new_cache["k_scale"] = jnp.stack(new_ks)
+        new_cache["v_scale"] = jnp.stack(new_vs)
+    return logits, new_cache
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0, 1), static_argnames=("page_tokens",)
+    jax.jit, donate_argnums=(0, 1, 2), static_argnames=("page_tokens",)
 )
-def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, base, *, page_tokens):
+def _paged_insert_jit(arena_k, arena_v, scales, pk, pv, table_row, base, *,
+                      page_tokens):
     """Scatter one admitted request's prefill K/V (layers, 1, n_kv, P_pad,
     hd) into its reserved pages: logical row ``r`` goes to page
     ``table_row[r // page_tokens]`` offset ``r % page_tokens``. ``table_row``
@@ -459,7 +510,10 @@ def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, base, *, page_tokens)
     trash page — prefill stops at the shared boundary and only private
     pages are written. base=0 is the plain unshared insert. One compile
     per P_pad bucket, same bound as the prefill itself (base is data, not
-    a signature)."""
+    a signature). ``scales`` is the int8 arena's {"k", "v"} per-row scale
+    buffers (donated; None for a dense-dtype arena): prefill rows are
+    quantized here with the same per-row absmax discipline as the decode
+    write, so a page is bit-identical whether filled by prefill or steps."""
     p_pad = pk.shape[3]
     pps = table_row.shape[0]
     rows = jnp.arange(p_pad)
@@ -471,48 +525,68 @@ def _paged_insert_jit(arena_k, arena_v, pk, pv, table_row, base, *, page_tokens)
     # to the front of the updated slice
     kv = pk[:, 0].transpose(2, 0, 1, 3)
     vv = pv[:, 0].transpose(2, 0, 1, 3)
+    if scales is not None:
+        kv, k_s = _quantize_kv_rows(kv)
+        vv, v_s = _quantize_kv_rows(vv)
+        scales = {
+            "k": scales["k"].at[:, pages, :, offs].set(k_s),
+            "v": scales["v"].at[:, pages, :, offs].set(v_s),
+        }
     arena_k = arena_k.at[:, pages, :, offs, :].set(kv.astype(arena_k.dtype))
     arena_v = arena_v.at[:, pages, :, offs, :].set(vv.astype(arena_v.dtype))
-    return arena_k, arena_v
+    return arena_k, arena_v, scales
 
 
 @jax.jit
-def _paged_gather_prefix_jit(arena_k, arena_v, pages):
+def _paged_gather_prefix_jit(arena_k, arena_v, scales, pages):
     """Gather ``n`` full shared-prefix pages into the dense
     (layers, 1, n_kv, n*page_tokens, hd) layout `_slot_prefill_from_cache_jit`
     expects as its cached prefix. Read-only on the arena (no donation — the
     shared pages stay live for every other referencing lane). One compile
-    per distinct page count, bounded by pages_per_slot."""
+    per distinct page count, bounded by pages_per_slot. An int8 arena
+    (``scales`` not None) is dequantized here: the suffix prefill runs on
+    dense f32 rows either way."""
     # arena: (layers, n_pages, n_kv, page_tokens, hd); pages: (n,) i32
     k = arena_k[:, pages]                       # (L, n, n_kv, pt, hd)
     v = arena_v[:, pages]
+    if scales is not None:
+        k = k.astype(jnp.float32) * scales["k"][:, pages][..., None]
+        v = v.astype(jnp.float32) * scales["v"][:, pages][..., None]
     layers, n, n_kv, pt, hd = k.shape
     k = k.swapaxes(1, 2).reshape(layers, n_kv, n * pt, hd)[:, None]
     v = v.swapaxes(1, 2).reshape(layers, n_kv, n * pt, hd)[:, None]
     return k, v
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _page_copy_jit(arena_k, arena_v, src, dst):
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _page_copy_jit(arena_k, arena_v, scales, src, dst):
     """Copy one arena page ``src`` -> ``dst`` in place (donated buffers, no
     arena-sized copy). This is the copy-on-write fast path: the host swaps
     the lane's block-table entry to ``dst`` afterwards and decrefs ``src``.
     ``src``/``dst`` are traced scalars, so every CoW event reuses the single
-    compiled program — the decode-chunk program count is untouched."""
+    compiled program — the decode-chunk program count is untouched. An int8
+    arena's per-row scales (``scales`` {"k","v"}, donated) travel with the
+    page bytes — a CoW'd or published page stays bit-identical."""
     arena_k = arena_k.at[:, dst].set(arena_k[:, src])
     arena_v = arena_v.at[:, dst].set(arena_v[:, src])
-    return arena_k, arena_v
+    if scales is not None:
+        scales = {
+            "k": scales["k"].at[:, dst].set(scales["k"][:, src]),
+            "v": scales["v"].at[:, dst].set(scales["v"][:, src]),
+        }
+    return arena_k, arena_v, scales
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg_key", "family", "chunk", "page_tokens"),
-    donate_argnums=(1, 2),
+    static_argnames=("cfg_key", "family", "chunk", "page_tokens", "kernel"),
+    donate_argnums=(1, 2, 3),
 )
 def _paged_decode_chunk_jit(
     params,
     arena_k,             # (layers, n_pages, n_kv, page_tokens, hd) — donated
     arena_v,
+    scales,              # {"k","v"} int8 per-row scale buffers | None — donated
     tables,              # (S, pages_per_slot) i32 block tables
     tok,                 # (S,) last sampled token per lane
     pos,                 # (S,) i32 write position per lane
@@ -525,29 +599,40 @@ def _paged_decode_chunk_jit(
     family: str = "transformer_lm",
     chunk: int,
     page_tokens: int,
+    kernel: bool = False,
 ):
     """Paged counterpart of ``_decode_chunk_jit``: same scan, same frozen
     inactive-lane convention, but K/V live in the shared page arena and
     each lane reads through its block table. ``tables`` is traced (a tiny
     (S, pages_per_slot) i32 H2D copy per chunk), so recycling pages never
-    mints a new program; compiled-program count stays one per chunk size."""
+    mints a new program; compiled-program count stays one per chunk size
+    (x2 for the ``kernel`` boolean — the serving.kv_paged_kernel gate)."""
     cfg = dict(cfg_key)
+    quantized = scales is not None
 
     def step(carry, rng):
-        k, v, tok, pos = carry
+        cache, tok, pos = carry
         logits, cache = _paged_forward_step(
-            params, tok, {"k": k, "v": v}, tables, pos, cfg, family,
-            page_tokens,
+            params, tok, cache, tables, pos, cfg, family,
+            page_tokens, kernel=kernel,
         )
         nxt = _sample_per_row(logits[:, 0], rng, temperature, top_k)
         nxt = jnp.where(active, nxt, tok)
         pos = pos + active.astype(jnp.int32)
-        return (cache["k"], cache["v"], nxt, pos), nxt
+        return (cache, nxt, pos), nxt
 
-    (arena_k, arena_v, tok, pos), toks = jax.lax.scan(
-        step, (arena_k, arena_v, tok, pos), rngs, length=chunk
+    cache = {"k": arena_k, "v": arena_v}
+    if quantized:
+        cache["k_scale"] = scales["k"]
+        cache["v_scale"] = scales["v"]
+    (cache, tok, pos), toks = jax.lax.scan(
+        step, (cache, tok, pos), rngs, length=chunk
     )
-    return arena_k, arena_v, tok, pos, jnp.transpose(toks, (1, 0))  # (S, chunk)
+    scales = (
+        {"k": cache["k_scale"], "v": cache["v_scale"]} if quantized else None
+    )
+    return (cache["k"], cache["v"], scales, tok, pos,
+            jnp.transpose(toks, (1, 0)))  # (S, chunk)
 
 
 def _ffn_block(layer: dict, x, cfg: dict, family: str, dtype):

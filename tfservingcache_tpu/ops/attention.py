@@ -25,6 +25,13 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _tpu_compiler_params(pltpu, dimension_semantics: tuple):
+    """jax moved TPUCompilerParams -> CompilerParams across the versions this
+    repo meets in the wild; resolve whichever this jax ships."""
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=dimension_semantics)
+
+
 # ---------------------------------------------------------------------------
 # jnp reference implementation
 # ---------------------------------------------------------------------------
@@ -275,8 +282,8 @@ def flash_attention(
             out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             out_shape=jax.ShapeDtypeStruct((b * h, sp, d), q.dtype),
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary")
+            compiler_params=_tpu_compiler_params(
+                pltpu, ("parallel", "arbitrary")
             ),
         )(qf, kf, vf)
     else:
@@ -303,8 +310,8 @@ def flash_attention(
                 pltpu.VMEM((block_q, 128), jnp.float32),    # l (lane-bcast)
             ],
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary")
+            compiler_params=_tpu_compiler_params(
+                pltpu, ("parallel", "parallel", "arbitrary")
             ),
         )(qf, kf, vf)
     out = out.reshape(b, h, sp, d)
@@ -463,8 +470,8 @@ def flash_attention_carry(
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
+        compiler_params=_tpu_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary")
         ),
     )(rel_arr, qf, kf, vf, accf, mf, lf)
     return (
@@ -491,7 +498,17 @@ def paged_gather_kv(
     ``(S, Hkv, pages_per_slot * page_tokens, D)`` is positionally identical
     to a dense per-lane cache row and the dense causal mask applies as-is.
     A lane only ever gathers its OWN pages plus the shared trash page, so
-    no cross-lane bytes are touched even before masking."""
+    no cross-lane bytes are touched even before masking.
+
+    SILENT-JUNK HAZARD (documented + checked, ISSUE 14): a table entry of
+    0 is the trash page — last-writer junk from every parked lane. Junk is
+    harmless only while it sits strictly ABOVE ``pos`` (the mask hides it);
+    a live lane whose table maps page 0 at a slot BELOW ``pos // page_tokens``
+    would attend over garbage with no error anywhere. The admission
+    protocol guarantees this cannot happen (reserve_pages covers the full
+    prompt + max_new budget up front); ``TPUSC_PAGECHECK=1`` turns the
+    guarantee into an assertion at every chunk dispatch
+    (model_runtime._check_trash_unreachable)."""
     s_lanes, pps = tables.shape
     _, hkv, pt, d = pages.shape
     gathered = pages[tables]                       # (S, PPS, Hkv, pt, D)
@@ -547,6 +564,192 @@ def paged_decode_attention(
     return out.reshape(s_lanes, hq, 1, d)
 
 
+def dequantize_pages(pages: jax.Array, scales: jax.Array) -> jax.Array:
+    """Expand an int8 page arena ``(n_pages, Hkv, page_tokens, D)`` against
+    its per-(page, head, token) f32 scales ``(n_pages, Hkv, page_tokens)``
+    back to f32 rows. This is the REFERENCE dequant — the Pallas paged
+    kernel performs the same multiply in VMEM on the one page it just
+    streamed, so the f32 arena never materializes in HBM on the fast path."""
+    return pages.astype(jnp.float32) * scales[..., None]
+
+
+def _paged_decode_kernel(
+    tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest, sm_scale: float,
+    page_tokens: int, num_pages: int, quantized: bool,
+):
+    """One (lane, kv-head, table-slot) grid step of paged decode attention.
+
+    The grid's last dimension walks the lane's block-table row; the
+    BlockSpec index maps (scalar-prefetched tables/pos) turn each step into
+    a DMA of exactly one arena page — the kernel reads the arena IN PLACE,
+    so the ``pages[tables]`` gathered intermediate of ``paged_gather_kv``
+    (a full extra HBM round-trip of every lane's live KV per decode step)
+    never exists. Online-softmax carry lives in VMEM scratch exactly like
+    ``_flash_streamed_kernel``; table slots past ``pos // page_tokens`` are
+    clamped to the last live page by the index map (consecutive equal block
+    indices elide the re-fetch) and skipped by ``pl.when``, so bytes
+    streamed track each lane's true length, not pages_per_slot.
+
+    ``quantized``: K/V blocks arrive int8 with per-(page, head, token) f32
+    scale rows; dequant happens here, on the VMEM-resident page — int8
+    halves the HBM bytes per KV token, which is the whole win."""
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_s, m_s, l_s = rest
+    else:
+        o_ref, acc_s, m_s, l_s = rest
+
+    s_i = pl.program_id(0)
+    j = pl.program_id(2)
+    pos = pos_ref[s_i]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    # a table slot is live iff its first token is at or before pos — the
+    # same visibility rule as the reference mask, so the two paths reduce
+    # over the same token set
+    @pl.when(j <= pos // page_tokens)
+    def _body():
+        q = q_ref[0, 0]                                     # (g, d)
+        k = k_ref[0, 0]                                     # (pt, d)
+        v = v_ref[0, 0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
+            q = q.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                        # (g, pt) f32
+        k_pos = j * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(k_pos <= pos, s, NEG_INF)
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # j <= pos//page_tokens guarantees >= 1 visible token in this page,
+        # so m_new is finite and masked entries underflow to exactly 0
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_s[...] = acc_s[...] * alpha + pv
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(j == num_pages - 1)
+    def _final():
+        o_ref[0, 0] = (
+            acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_tokens", "interpret"))
+def paged_decode_attention_kernel(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    *,
+    page_tokens: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused paged decode attention: same contract as
+    ``paged_decode_attention`` (q ``(S, Hq, 1, D)``, arena pages
+    ``(n_pages, Hkv, page_tokens, D)``, tables ``(S, pages_per_slot)``,
+    pos ``(S,)`` -> f32 ``(S, Hq, 1, D)``), but ONE pass over the KV bytes:
+    block tables and positions ride in as scalar-prefetch operands so the
+    Pallas pipeline itself walks each lane's pages straight out of the
+    arena. With ``k_scale``/``v_scale`` (``(n_pages, Hkv, page_tokens)``
+    f32) the arena is int8 and dequantized in VMEM per streamed page.
+
+    Tables/pos are TRACED data (SMEM), same discipline as the reference
+    path: page recycling/admission churn never mints a new program."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s_lanes, hq, _, d = q.shape
+    n_pages_arena, hkv, pt, _ = k_pages.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if pt != page_tokens:
+        raise ValueError(f"arena page_tokens {pt} != {page_tokens}")
+    g = hq // hkv
+    pps = tables.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    quantized = k_scale is not None
+
+    qg = q.reshape(s_lanes, hkv, g, d)
+    tables = tables.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+
+    def q_index(s, h, j, tbl, ps):
+        return (s, h, 0, 0)
+
+    def kv_index(s, h, j, tbl, ps):
+        # clamp dead trailing slots to the lane's last live page: the block
+        # index repeats, so the pipeline skips the re-fetch — streamed bytes
+        # scale with pos, and the trash page behind unreserved entries is
+        # only ever touched where the reference would read it too
+        jj = jnp.minimum(j, ps[s] // page_tokens)
+        return (tbl[s, jj], h, 0, 0)
+
+    def scale_index(s, h, j, tbl, ps):
+        jj = jnp.minimum(j, ps[s] // page_tokens)
+        return (tbl[s, jj], h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, d), q_index),
+        pl.BlockSpec((1, 1, pt, d), kv_index),
+        pl.BlockSpec((1, 1, pt, d), kv_index),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, pt), scale_index),
+            pl.BlockSpec((1, 1, pt), scale_index),
+        ]
+        operands += [k_scale, v_scale]
+
+    kernel = functools.partial(
+        _paged_decode_kernel, sm_scale=sm_scale, page_tokens=page_tokens,
+        num_pages=pps, quantized=quantized,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_lanes, hkv, pps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),      # acc
+            pltpu.VMEM((g, 128), jnp.float32),    # m (lane-bcast)
+            pltpu.VMEM((g, 128), jnp.float32),    # l (lane-bcast)
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_lanes, hkv, g, d), jnp.float32),
+        interpret=interpret,
+        compiler_params=_tpu_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary")
+        ),
+    )(tables, pos, *operands)
+    return out.reshape(s_lanes, hq, 1, d)
+
+
 TPU_BACKENDS = ("tpu", "axon")  # axon = tunneled TPU plugin in this image
 
 
@@ -567,3 +770,47 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True) -> 
     ):
         return flash_attention(q, k, v, causal=causal)
     return attention_reference(q, k, v, causal=causal)
+
+
+# Tests flip this to force the Pallas paged kernel through its interpreter
+# on CPU (tier-1 parity without a chip). Trace-time only: flip it BEFORE the
+# first paged dispatch or clear the jit caches of callers.
+PAGED_KERNEL_INTERPRET = False
+
+
+def paged_attention(  # static-bounded: kernel, page_tokens, PAGED_KERNEL_INTERPRET -- kernel and the interpret flag are booleans (two programs max); page_tokens is one value per slot state (ServingConfig kv_page_tokens)
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    page_tokens: int,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    kernel: bool = True,
+) -> jax.Array:
+    """Paged decode dispatch, mirroring ``attention``'s gate: the fused
+    Pallas kernel on TPU backends when shapes qualify (head_dim a multiple
+    of 64 — Mosaic pads the lane dim; GQA divisibility), the gather+einsum
+    reference everywhere else. ``kernel=False`` (serving.kv_paged_kernel)
+    forces the reference path unconditionally — byte-for-byte today's
+    behavior. An int8 arena (``k_scale`` present) is dequantized in-kernel
+    on the fast path; the reference fallback materializes the dequantized
+    pages first (exact same math, minus the bandwidth win)."""
+    if kernel and (
+        PAGED_KERNEL_INTERPRET
+        or (
+            jax.default_backend() in TPU_BACKENDS
+            and q.shape[-1] % 64 == 0
+            and q.shape[1] % k_pages.shape[1] == 0
+        )
+    ):
+        return paged_decode_attention_kernel(
+            q, k_pages, v_pages, tables, pos, k_scale, v_scale,
+            page_tokens=page_tokens, interpret=PAGED_KERNEL_INTERPRET,
+        )
+    if k_scale is not None:
+        k_pages = dequantize_pages(k_pages, k_scale)
+        v_pages = dequantize_pages(v_pages, v_scale)
+    return paged_decode_attention(q, k_pages, v_pages, tables, pos,
+                                  page_tokens)

@@ -74,6 +74,8 @@ class LocalServingBackend(ServingBackend):
         kv_page_tokens: int = 0,
         kv_arena_pages: int = 0,
         kv_share_prefix_bytes: int = 0,
+        kv_paged_kernel: bool = True,
+        kv_arena_dtype: str = "",
     ) -> None:
         self.manager = manager
         # JAX dispatch is effectively serialized per device; a few workers
@@ -121,6 +123,8 @@ class LocalServingBackend(ServingBackend):
                 page_tokens=kv_page_tokens,
                 arena_pages=kv_arena_pages,
                 share_prefix_bytes=kv_share_prefix_bytes,
+                arena_dtype=kv_arena_dtype,
+                paged_kernel=kv_paged_kernel,
             )
 
     async def _run(self, fn, *args):

@@ -796,7 +796,9 @@ class _ContinuousScheduler:
             try:
                 if state is None:
                     if eng.page_tokens is None and \
-                            eng.share_prefix_bytes is None:
+                            eng.share_prefix_bytes is None and \
+                            eng.arena_dtype is None and \
+                            eng.paged_kernel is None:
                         # no engine-level override: the runtime's ServingConfig
                         # decides (and stub runtimes keep their 2-arg surface)
                         state = rt.slot_decode_state(self.model_id, eng.slots)
@@ -807,6 +809,10 @@ class _ContinuousScheduler:
                             kw["arena_pages"] = eng.arena_pages
                         if eng.share_prefix_bytes is not None:
                             kw["share_prefix_bytes"] = eng.share_prefix_bytes
+                        if eng.arena_dtype is not None:
+                            kw["arena_dtype"] = eng.arena_dtype
+                        if eng.paged_kernel is not None:
+                            kw["paged_kernel"] = eng.paged_kernel
                         state = rt.slot_decode_state(
                             self.model_id, eng.slots, **kw
                         )
@@ -1156,6 +1162,8 @@ class ContinuousGenerateEngine:
         page_tokens: int | None = None,
         arena_pages: int | None = None,
         share_prefix_bytes: int | None = None,
+        arena_dtype: str | None = None,
+        paged_kernel: bool | None = None,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
@@ -1170,6 +1178,15 @@ class ContinuousGenerateEngine:
         self.arena_pages = None if arena_pages is None else int(arena_pages)
         self.share_prefix_bytes = (
             None if share_prefix_bytes is None else int(share_prefix_bytes)
+        )
+        # same None-defers convention: kv_arena_dtype ("" = model dtype,
+        # "int8" = quantized pages — byte-matched auto-size means MORE pages
+        # for the same budget, so admission capacity grows with no batcher
+        # change: reserve_pages just sees a longer free-list) and
+        # kv_paged_kernel (fused Pallas decode vs gather+einsum reference)
+        self.arena_dtype = None if arena_dtype is None else str(arena_dtype)
+        self.paged_kernel = (
+            None if paged_kernel is None else bool(paged_kernel)
         )
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}

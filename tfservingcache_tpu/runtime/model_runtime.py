@@ -21,6 +21,7 @@ dynamic shapes would otherwise force an XLA recompile per novel batch.
 from __future__ import annotations
 
 import functools
+import os
 import queue
 import threading
 import time
@@ -498,6 +499,16 @@ class SlotDecodeState:
     page_tokens: int = 0
     arena_pages: int = 0             # usable pages (excludes trash page 0)
     pages_per_slot: int = 0          # ceil(max_seq / page_tokens)
+    # int8 arena (serving.kv_arena_dtype): per-row f32 scale buffers riding
+    # with the page payload ({"k","v"} device arrays, None for dense dtype).
+    # All page bookkeeping above is PAGE-COUNT based, so quantization never
+    # touches reserve/release/CoW/census semantics — scales just travel with
+    # every page write/copy.
+    scales: Any = None
+    arena_dtype: str = ""            # "" = model dtype; "int8" = quantized
+    # serving.kv_paged_kernel: fused Pallas paged-attention decode kernel
+    # (ops/attention.paged_attention gate) vs the gather+einsum reference
+    kernel: bool = True
     block_tables: np.ndarray | None = None   # (S, pages_per_slot) i32
     free_pages: list = field(default_factory=list)
     lane_pages: dict = field(default_factory=dict)  # lane -> [page ids]
@@ -643,6 +654,36 @@ class SlotDecodeState:
                 assert got == refs, (
                     f"page {pg}: page_refs says {got}, census says {refs}"
                 )
+
+
+# TPUSC_PAGECHECK=1 (same opt-in idiom as utils/lockcheck.py's
+# TPUSC_LOCKCHECK): assert before every paged decode chunk that no LIVE
+# lane's block table maps the trash page below its visible position.
+# `paged_gather_kv` / the Pallas kernel read whatever the table points at —
+# a trash-page entry behind `pos` would silently attend over junk KV (no
+# crash, just wrong tokens), which is exactly the failure mode this guard
+# exists to catch in tests and soaks.
+_PAGECHECK = os.environ.get("TPUSC_PAGECHECK", "") == "1"
+
+
+def _check_trash_unreachable(state: SlotDecodeState) -> None:
+    """Raise if any active lane's block-table row maps page 0 (trash) in a
+    slot the lane's attention window can reach (pages covering tokens
+    0..pos inclusive). Host-only, O(slots x pages_per_slot)."""
+    for lane in range(state.slots):
+        if not bool(state.active[lane]):
+            continue
+        # pos is the NEXT write position; the chunk's first step writes at
+        # pos and attends over 0..pos inclusive
+        live = state.pages_needed(int(state.pos[lane]) + 1)
+        row = state.block_tables[lane, :live]
+        if (row == 0).any():
+            bad = int(np.argmax(row == 0))
+            raise AssertionError(
+                f"TPUSC_PAGECHECK: lane {lane} maps trash page 0 at "
+                f"block-table slot {bad} below pos={int(state.pos[lane])} "
+                f"(live pages={live}) — attention would read junk KV"
+            )
 
 
 @lockchecked
@@ -1654,6 +1695,8 @@ class TPUModelRuntime(BaseRuntime):
         page_tokens: int | None = None,
         arena_pages: int | None = None,
         share_prefix_bytes: int | None = None,
+        arena_dtype: str | None = None,
+        paged_kernel: bool | None = None,
     ) -> SlotDecodeState:
         """Create-or-get the model's slot state. One compiled decode-chunk
         program serves all ``slots`` lanes. ``page_tokens`` / ``arena_pages``
@@ -1661,7 +1704,9 @@ class TPUModelRuntime(BaseRuntime):
         keeps the dense (layers, slots, n_kv, max_seq, head_dim) slot array,
         ``> 0`` allocates the paged arena instead (``arena_pages == 0`` auto-
         sizes to slots x ceil(max_seq/page_tokens) — the dense-equivalent
-        byte budget). An existing state always wins; later callers' knobs
+        byte budget; with ``arena_dtype == "int8"`` the page count grows to
+        fill the SAME byte budget, which is where the capacity win comes
+        from). An existing state always wins; later callers' knobs
         are ignored, same as ``slots``.
 
         Allocation runs under a per-model once-guard, NOT under
@@ -1692,7 +1737,7 @@ class TPUModelRuntime(BaseRuntime):
                 return st  # the racer that held the guard built it
             st = self._build_slot_state(
                 loaded, model_id, slots, page_tokens, arena_pages,
-                share_prefix_bytes,
+                share_prefix_bytes, arena_dtype, paged_kernel,
             )
             with self._slot_lock:
                 st = self._slot_states.setdefault(model_id, st)
@@ -1707,6 +1752,8 @@ class TPUModelRuntime(BaseRuntime):
         page_tokens: int | None,
         arena_pages: int | None,
         share_prefix_bytes: int | None = None,
+        arena_dtype: str | None = None,
+        paged_kernel: bool | None = None,
     ) -> SlotDecodeState:
         from tfservingcache_tpu.models.generation import (
             init_cache,
@@ -1721,6 +1768,10 @@ class TPUModelRuntime(BaseRuntime):
             share_prefix_bytes = int(
                 getattr(self.cfg, "kv_share_prefix_bytes", 0)
             )
+        if arena_dtype is None:
+            arena_dtype = str(getattr(self.cfg, "kv_arena_dtype", "") or "")
+        if paged_kernel is None:
+            paged_kernel = bool(getattr(self.cfg, "kv_paged_kernel", True))
         cfg = loaded.model_def.config
         max_seq = int(cfg["max_seq"])
         common = dict(
@@ -1739,23 +1790,46 @@ class TPUModelRuntime(BaseRuntime):
             page_tokens = int(page_tokens)
             pps = -(-max_seq // page_tokens)
             usable = int(arena_pages) if arena_pages else slots * pps
+            if not arena_pages and arena_dtype == "int8":
+                # Byte-matched auto-size: int8 pages are smaller (1-byte
+                # payload + 4-byte f32 scale per row vs the dense itemsize),
+                # so the SAME byte budget holds more pages — that growth IS
+                # the int8 capacity win. Explicit kv_arena_pages is honored
+                # verbatim (bench arms pass matched budgets themselves).
+                import jax.numpy as jnp
+
+                hd = int(cfg["d_model"]) // int(cfg["n_heads"])
+                dense_item = jnp.dtype(
+                    cfg.get("dtype", "bfloat16")
+                ).itemsize
+                usable = max(
+                    usable, (usable * hd * dense_item) // (hd + 4)
+                )
             # +1: page 0 is the trash page, permanently reserved
-            cache = init_paged_cache(cfg, usable + 1, page_tokens)
+            cache = init_paged_cache(cfg, usable + 1, page_tokens, arena_dtype)
+            scales = None
+            if "k_scale" in cache:
+                scales = {"k": cache["k_scale"], "v": cache["v_scale"]}
             prefix_index = None
             if share_prefix_bytes and share_prefix_bytes > 0:
                 from tfservingcache_tpu.runtime.prefix_cache import (
                     PagePrefixIndex,
                 )
 
-                page_nbytes = (
-                    int(cache["k"].nbytes) + int(cache["v"].nbytes)
+                page_nbytes = sum(
+                    int(a.nbytes)
+                    for a in (cache["k"], cache["v"],
+                              *(scales.values() if scales else ()))
                 ) // (usable + 1)
                 prefix_index = PagePrefixIndex(
                     page_tokens, page_nbytes, int(share_prefix_bytes)
                 )
-            return SlotDecodeState(
+            st = SlotDecodeState(
                 k=cache["k"],
                 v=cache["v"],
+                scales=scales,
+                arena_dtype=arena_dtype,
+                kernel=bool(paged_kernel),
                 page_tokens=page_tokens,
                 arena_pages=usable,
                 pages_per_slot=pps,
@@ -1765,12 +1839,34 @@ class TPUModelRuntime(BaseRuntime):
                 prefix_index=prefix_index,
                 **common,
             )
+            self._note_arena_bytes(st)
+            return st
         cache = init_cache(cfg, slots, max_seq)
-        return SlotDecodeState(k=cache["k"], v=cache["v"], **common)
+        return SlotDecodeState(
+            k=cache["k"], v=cache["v"],
+            kernel=bool(paged_kernel), **common,
+        )
+
+    def _note_arena_bytes(self, state: SlotDecodeState) -> None:
+        """Publish ``tpusc_gen_kv_arena_bytes{dtype}`` for a freshly built
+        paged arena. Gauge semantics are "bytes currently allocated with
+        this dtype label"; drop paths zero the label rather than tracking a
+        cross-model sum (one continuous-decode model per runtime in
+        practice — the engine keys slot state by model_id)."""
+        if self.metrics is None or not state.page_tokens:
+            return
+        label = state.arena_dtype or str(state.k.dtype)
+        nbytes = int(state.k.nbytes) + int(state.v.nbytes)
+        if state.scales is not None:
+            nbytes += sum(int(a.nbytes) for a in state.scales.values())
+        self.metrics.gen_kv_arena_bytes.labels(dtype=label).set(nbytes)
 
     def drop_slot_state(self, model_id: ModelId) -> None:
         with self._slot_lock:
-            self._slot_states.pop(model_id, None)
+            st = self._slot_states.pop(model_id, None)
+        if st is not None and st.page_tokens and self.metrics is not None:
+            label = st.arena_dtype or str(st.k.dtype)
+            self.metrics.gen_kv_arena_bytes.labels(dtype=label).set(0)
 
     def slot_prefill(
         self,
@@ -1937,7 +2033,7 @@ class TPUModelRuntime(BaseRuntime):
         cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
         covered = plan.covered
         ck, cv = _paged_gather_prefix_jit(
-            state.k, state.v, np.asarray(plan.pages, np.int32)
+            state.k, state.v, state.scales, np.asarray(plan.pages, np.int32)
         )
         suffix_len = p - covered
         s_pad = next_bucket(suffix_len)
@@ -1968,8 +2064,8 @@ class TPUModelRuntime(BaseRuntime):
                 "(cow_headroom was not reserved?)"
             )
         src, dst = swap
-        state.k, state.v = _page_copy_jit(
-            state.k, state.v, np.int32(src), np.int32(dst)
+        state.k, state.v, state.scales = _page_copy_jit(
+            state.k, state.v, state.scales, np.int32(src), np.int32(dst)
         )
 
     def shared_prefix_publish(
@@ -2007,8 +2103,9 @@ class TPUModelRuntime(BaseRuntime):
         if tail_len and last_logits is not None and state.free_pages:
             src = lane_pg[n_full]
             boundary = state.free_pages.pop()
-            state.k, state.v = _page_copy_jit(
-                state.k, state.v, np.int32(src), np.int32(boundary)
+            state.k, state.v, state.scales = _page_copy_jit(
+                state.k, state.v, state.scales,
+                np.int32(src), np.int32(boundary)
             )
         added, released = idx.insert(
             prompt, lane_pg[:n_full], boundary, last_logits, state.page_refs
@@ -2064,8 +2161,8 @@ class TPUModelRuntime(BaseRuntime):
         )
 
         if state.paged:
-            state.k, state.v = _paged_insert_jit(
-                state.k, state.v, pk, pv,
+            state.k, state.v, state.scales = _paged_insert_jit(
+                state.k, state.v, state.scales, pk, pv,
                 np.asarray(state.block_tables[idx], np.int32),
                 np.int32(base_tokens),
                 page_tokens=state.page_tokens,
@@ -2096,13 +2193,16 @@ class TPUModelRuntime(BaseRuntime):
             jax.random.PRNGKey(state.chunk_counter), chunk
         )
         if state.paged:
-            state.k, state.v, tok, pos, toks = _paged_decode_chunk_jit(
-                loaded.params, state.k, state.v,
+            if _PAGECHECK:
+                _check_trash_unreachable(state)
+            (state.k, state.v, state.scales, tok, pos,
+             toks) = _paged_decode_chunk_jit(
+                loaded.params, state.k, state.v, state.scales,
                 np.asarray(state.block_tables, np.int32),
                 state.tok, state.pos, state.active, rngs,
                 state.temps, state.topks,
                 cfg_key=state.cfg_key, family=state.family, chunk=chunk,
-                page_tokens=state.page_tokens,
+                page_tokens=state.page_tokens, kernel=state.kernel,
             )
         else:
             state.k, state.v, tok, pos, toks = _decode_chunk_jit(

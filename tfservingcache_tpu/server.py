@@ -179,6 +179,8 @@ class CacheNode:
                 kv_page_tokens=cfg.serving.kv_page_tokens,
                 kv_arena_pages=cfg.serving.kv_arena_pages,
                 kv_share_prefix_bytes=cfg.serving.kv_share_prefix_bytes,
+                kv_paged_kernel=cfg.serving.kv_paged_kernel,
+                kv_arena_dtype=cfg.serving.kv_arena_dtype,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first

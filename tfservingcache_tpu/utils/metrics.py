@@ -272,6 +272,14 @@ class Metrics:
             "prefix-cache reuse)",
             ["engine", "kind"], registry=r,
         )
+        self.gen_kv_arena_bytes = Gauge(
+            "tpusc_gen_kv_arena_bytes",
+            "Device bytes allocated to the paged KV arena (pages plus, "
+            "for dtype=int8, the f32 dequant scale buffers), labeled by "
+            "arena element type (serving.kv_arena_dtype; the model dtype "
+            "when unset) — capacity-vs-budget evidence for the int8 arena",
+            ["dtype"], registry=r,
+        )
         self.gen_kv_page_waste = Histogram(
             "tpusc_gen_kv_page_waste_tokens",
             "Per retired row: reserved page capacity minus tokens that "

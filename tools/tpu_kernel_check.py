@@ -34,10 +34,14 @@ def main() -> int:
         extra = ["-k", "on_tpu"]
     # -s: the gated tests print per-shape flash/jnp ms + TF/s — the artifact
     # must carry the measured magnitudes, not just PASS/FAIL (VERDICT r3
-    # missing #2: "commit magnitudes, not verdicts")
+    # missing #2: "commit magnitudes, not verdicts"). test_paged_kernel.py
+    # carries the `paged_decode` entries: kernel-vs-gather+einsum max-abs-err
+    # and the bandwidth-proxy timing ratio at S in {4,16,32} lanes, plus the
+    # int8 in-kernel dequant proof.
     cmd = [
         sys.executable, "-m", "pytest",
         os.path.join(REPO, "tests", "test_attention.py"),
+        os.path.join(REPO, "tests", "test_paged_kernel.py"),
         "-v", "-rs", "-s", "--no-header",
         *extra,
     ]
