@@ -278,7 +278,7 @@ SECTION_GROUPS = (
     "mnist_qps", "routed", "lm_throughput", "lm_qps", "spec_decode",
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
     "warm_tier", "peer_cold_start", "cold_pipeline", "paged_kv",
-    "shared_prefix", "paged_kernel",
+    "shared_prefix", "paged_kernel", "spec_continuous",
 )
 
 
@@ -2487,6 +2487,177 @@ def bench_paged_kernel(tmp: str, lm_config: dict) -> dict:
     return out
 
 
+def bench_spec_continuous(tmp: str, lm_config: dict) -> dict:
+    """Does IN-ENGINE speculation help the continuous paged engine?
+    (ISSUE 16 tentpole.) The solo spec_decode section prices the feature at
+    B=1 through runtime.generate; this one prices it where it actually
+    serves: a seeded Poisson swarm over the slotted paged engine, spec
+    rounds on vs plain chunks, at matched TARGET arena bytes and matched
+    per-dispatch emission capacity (plain chunk = spec_tokens + 1).
+
+    Both arms serve the residual-damped ALIGNED target with its early-exit
+    draft (the acceptance-ceiling pair from spec_decode — what a deployed
+    distilled draft looks like), so the tok/s ratio is the feature's
+    headline. Acceptance is MEASURED (accepted tokens per verify round off
+    the engine counters), greedy parity is probed outside the timing
+    window, and both arenas must pass the conservation census at drain —
+    a perf row that corrupts pages is not a perf row."""
+    import threading
+
+    import numpy as np
+
+    from tfservingcache_tpu.models.registry import build, save_artifact
+    from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+    from tfservingcache_tpu.types import ModelId
+    from tfservingcache_tpu.utils.metrics import Metrics
+
+    metrics = Metrics()
+    manager, runtime = _make_stack("transformer_lm", 1, tmp,
+                                   config=lm_config, resident_cap=4,
+                                   metrics=metrics)
+    store = os.path.join(tmp, "store-transformer_lm")
+    manager.ensure_servable(ModelId("tenant0", 1))
+    base = runtime._resident.get(ModelId("tenant0", 1))
+    aligned_params = _damped_aligned_params(base.params)
+    save_artifact(os.path.join(store, "target_aligned", "1"),
+                  base.model_def, aligned_params)
+    d_layers = max(1, lm_config["n_layers"] // 4)
+    draft_def = build("transformer_lm", dict(lm_config, n_layers=d_layers))
+    save_artifact(os.path.join(store, "draft_aligned", "1"), draft_def, {
+        "embed": aligned_params["embed"],
+        "ln_f": aligned_params["ln_f"],
+        "layers": [dict(l) for l in aligned_params["layers"][:d_layers]],
+    })
+    mid = ModelId("target_aligned", 1)
+    for name in ("target_aligned", "draft_aligned"):
+        manager.ensure_servable(ModelId(name, 1))
+
+    slots, spec_k, page_tokens, arena_pages = 4, 4, 16, 24
+    n_req = 16
+    vocab = lm_config["vocab_size"]
+    r = np.random.default_rng(42)
+    reqs = [
+        (
+            r.integers(0, vocab, int(r.integers(8, 17))).astype(np.int32),
+            int(r.integers(4, 33)),
+        )
+        for _ in range(n_req)
+    ]
+    arrivals = np.cumsum(r.exponential(0.02, n_req))
+    probe = r.integers(0, vocab, (4, 12)).astype(np.int32)
+
+    def replay(eng) -> dict:
+        results: list = [None] * n_req
+        errors: list = []
+
+        def client(i):
+            prompt, max_new = reqs[i]
+            try:
+                _, stats = eng.generate(
+                    mid, prompt[None], max_new_tokens=max_new,
+                    return_stats=True,
+                )
+                results[i] = (stats[0]["ttft_s"], stats[0]["tokens"])
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        start = time.perf_counter()
+        for i in range(n_req):
+            delay = arrivals[i] - (time.perf_counter() - start)
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        if errors:
+            raise RuntimeError(f"{len(errors)} failed: {errors[:3]}")
+        ttfts = sorted(t for t, _ in results)
+        toks = sum(n for _, n in results)
+        return {
+            "p50_ttft_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+            "p95_ttft_ms": round(
+                ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] * 1e3, 1
+            ),
+            "tok_s": round(toks / wall, 1),
+            "wall_s": round(wall, 2),
+            "tokens": toks,
+        }
+
+    def counter(c, label):
+        return float(c.labels(label)._value.get())
+
+    probe_tokens = {}
+
+    def run_arm(label: str, spec_on: bool) -> dict:
+        # reset the acceptance gate: a prior arm's (or section's) history
+        # must not auto-disable this arm's rounds mid-measurement
+        with runtime._spec_lock:
+            runtime._spec_health.clear()
+        eng = ContinuousGenerateEngine(
+            runtime, slots=slots, chunk_tokens=spec_k + 1, metrics=metrics,
+            page_tokens=page_tokens, arena_pages=arena_pages,
+            spec_draft_model="draft_aligned" if spec_on else "",
+            spec_tokens=spec_k,
+        )
+        try:
+            # warm the prefill/insert/chunk/spec-round compiles (and the
+            # draft attach) outside the timing window
+            eng.generate(mid, np.ones((1, 16), np.int32), max_new_tokens=4)
+            w0 = counter(metrics.gen_wasted_steps, "continuous")
+            a0 = counter(metrics.spec_accepted_tokens, "continuous")
+            r0 = counter(metrics.spec_rounds, "continuous")
+            arm = replay(eng)
+            arm["wasted_steps"] = int(
+                counter(metrics.gen_wasted_steps, "continuous") - w0
+            )
+            rounds = counter(metrics.spec_rounds, "continuous") - r0
+            if spec_on:
+                arm["verify_rounds"] = int(rounds)
+                arm["accepted_tokens_per_round"] = round(
+                    (counter(metrics.spec_accepted_tokens, "continuous") - a0)
+                    / max(1.0, rounds), 2
+                )
+            probe_tokens[label] = np.asarray(
+                eng.generate(mid, probe, max_new_tokens=16)
+            )
+            st = runtime._slot_states[mid]
+            st.check_page_conservation()
+            if st.spec_draft is not None:
+                st.spec_draft.check_page_conservation()
+            arm["arena_bytes"] = int(
+                st.k.nbytes + st.v.nbytes
+                + (st.scales.nbytes if st.scales is not None else 0)
+            )
+            arm["conservation_ok"] = True
+            return arm
+        finally:
+            eng.close()
+            runtime.drop_slot_state(mid)  # next arm allocates its own layout
+
+    out = {
+        "requests": n_req, "slots": slots, "spec_tokens": spec_k,
+        "page_tokens": page_tokens, "arena_pages": arena_pages,
+        "chunk_tokens": spec_k + 1,
+        "spec_off": run_arm("spec_off", spec_on=False),
+        "spec_on": run_arm("spec_on", spec_on=True),
+    }
+    out["tok_s_ratio"] = round(
+        out["spec_on"]["tok_s"] / max(1e-9, out["spec_off"]["tok_s"]), 2
+    )
+    out["wasted_steps_delta"] = (
+        out["spec_on"]["wasted_steps"] - out["spec_off"]["wasted_steps"]
+    )
+    out["greedy_match"] = bool(
+        (probe_tokens["spec_off"] == probe_tokens["spec_on"]).all()
+    )
+    manager.close()
+    return out
+
+
 def watcher_liveness() -> dict:
     """Probe-history summary from the watcher's state file + log, embedded
     into EVERY bench artifact — even a CPU-fallback run self-reports whether
@@ -2551,7 +2722,7 @@ def collect_watcher_evidence() -> dict:
         "mnist_cnn", "transformer_lm", "transformer_lm_q8", "chip_lm",
         "flash_kernel", "tenant_soak", "spec_decode", "prefix_gen",
         "continuous_batching", "zoo_cold", "warm_tier", "cold_pipeline",
-        "paged_kv", "shared_prefix", "paged_kernel",
+        "paged_kv", "shared_prefix", "paged_kernel", "spec_continuous",
         "device_kind", "chips", "only",
     )
     for fn in sorted(os.listdir(runs_dir)):
@@ -2898,6 +3069,15 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["paged_kernel"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("spec_continuous"):
+        try:
+            with _section("spec_continuous"):
+                detail["spec_continuous"] = bench_spec_continuous(
+                    os.path.join(tmp, "speccontinuous"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["spec_continuous"] = {"error": f"{type(e).__name__}: {e}"}
 
     _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):

@@ -162,6 +162,102 @@ def test_solo_fallbacks_and_close(tmp_path):
         eng.generate(mid, np.ones((1, 4), np.int32))
 
 
+# -- in-engine speculative decoding (ISSUE 16) --------------------------------
+
+DRAFT_TINY = dict(TINY, d_model=24, n_layers=1, n_heads=2, n_kv_heads=1,
+                  d_ff=48)
+
+
+@pytest.fixture(scope="module")
+def spec_stack(tmp_path_factory):
+    """ONE paged runtime with target 'lm' + independently-initialized draft
+    'draft' resident, shared by the spec tests below (exports, loads, and
+    the compiled prefill/chunk/spec-round programs are paid once; each test
+    drops the slot state so engine-level spec config starts fresh). The
+    eviction test unloads the draft and MUST run last in this module."""
+    tmp = tmp_path_factory.mktemp("spec_engine")
+    rt, mid = _load(tmp, kv_page_tokens=8)
+    export_artifact("transformer_lm", str(tmp), name="draft", version=1,
+                    config=DRAFT_TINY, seed=3)
+    d_mid = ModelId("draft", 1)
+    rt.ensure_loaded(Model(identifier=d_mid, path=str(tmp / "draft" / "1")))
+    yield rt, mid, d_mid
+    rt.close()
+
+
+def test_spec_greedy_parity_and_single_executable(spec_stack):
+    """Tentpole invariants: (1) spec-on greedy output is byte-identical to
+    spec-off — acceptance moves WHEN tokens are computed, never WHICH; (2)
+    per-row accept counts are traced data, so a full generate's worth of
+    varying acceptance patterns compiles exactly ONE spec-round
+    executable."""
+    from tfservingcache_tpu.models.speculative import _paged_spec_round_jit
+
+    rt, mid, _ = spec_stack
+    ids, lens = _ragged_prompts(rows=5, width=7, seed=4)
+    eng0 = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                    spec_draft_model="")  # explicitly off
+    try:
+        ref = eng0.generate(mid, ids, prompt_lengths=lens, max_new_tokens=12)
+    finally:
+        eng0.close()
+        rt.drop_slot_state(mid)
+    eng1 = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                    spec_draft_model="draft", spec_tokens=4)
+    _paged_spec_round_jit.clear_cache()
+    try:
+        got = eng1.generate(mid, ids, prompt_lengths=lens, max_new_tokens=12)
+        assert (got == ref).all()
+        st = rt._slot_states[mid]
+        assert st.spec_draft is not None      # rounds actually ran drafted
+        assert _paged_spec_round_jit._cache_size() == 1
+    finally:
+        eng1.close()
+        rt.drop_slot_state(mid)
+
+
+def test_spec_solo_vs_continuous_parity(spec_stack):
+    """The SAME (target, draft) pair through the solo speculative path
+    (dense KV, runtime.generate) and through continuous spec rounds (paged
+    arena) emits identical greedy streams."""
+    rt, mid, d_mid = spec_stack
+    ids, lens = _ragged_prompts(rows=3, width=7, seed=5)
+    eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                   spec_draft_model="draft", spec_tokens=4)
+    try:
+        solo = rt.generate(
+            mid, ids, prompt_lengths=lens, max_new_tokens=10,
+            temperature=0.0, draft_model_id=d_mid, spec_tokens=4,
+        )
+        cont = eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=10)
+        assert (np.asarray(cont) == np.asarray(solo)).all()
+    finally:
+        eng.close()
+        rt.drop_slot_state(mid)
+
+
+def test_spec_draft_eviction_detaches_and_decodes_plain(spec_stack):
+    """Evicting the draft between generates must detach the pair (no
+    exception plumbing into callers) and keep serving plain chunks with the
+    same greedy output. Unloads the shared stack's draft — keep this the
+    LAST spec test in the module."""
+    rt, mid, d_mid = spec_stack
+    ids, lens = _ragged_prompts(rows=2, width=6, seed=6)
+    eng = ContinuousGenerateEngine(rt, slots=4, chunk_tokens=4,
+                                   spec_draft_model="draft", spec_tokens=4)
+    try:
+        first = eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=8)
+        st = rt._slot_states[mid]
+        assert st.spec_draft is not None
+        rt.unload(d_mid)
+        second = eng.generate(mid, ids, prompt_lengths=lens, max_new_tokens=8)
+        assert (np.asarray(second) == np.asarray(first)).all()
+        assert rt._slot_states[mid].spec_draft is None
+    finally:
+        eng.close()
+        rt.drop_slot_state(mid)
+
+
 def test_backend_selects_continuous_engine(tmp_path):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager

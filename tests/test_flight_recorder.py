@@ -286,6 +286,7 @@ def test_snapshot_model_filter_and_engine_stats():
     assert stats["goodput"] == pytest.approx((48 - 4) / 48)
     assert FlightRecorder().engine_stats() == {
         "goodput": 1.0, "queue_depth": 0, "oldest_wait_ms": 0.0,
+        "spec_acceptance": 0.0,
     }
 
 
@@ -522,6 +523,34 @@ def test_ring_and_dump_render_shared_prefix_split(tmp_path, capsys):
     assert "prefix sharing: 3/4 admissions hit (rate=0.750)" in out
     assert "max shared pages=3" in out
     assert "pages=3s+5p/8f" in out  # 8 used = 3 shared + 5 private, 8 free
+
+def test_ring_and_dump_render_speculation(tmp_path, capsys):
+    """Speculation telemetry rides the step ring: drafted/accepted counts
+    aggregate into the window, the acceptance rate normalizes by emission
+    capacity over spec steps only, engine_stats() exposes the same rate,
+    and the dump tool renders the speculation line."""
+    fr = FlightRecorder(flight_dir=str(tmp_path))
+    # spec round: 2 active rows x chunk 5 (spec_tokens 4 + carry) = 10
+    # emission capacity; 7 tokens actually emitted
+    fr.record("m@1", "continuous", step_ms=1.0, chunk=5, active=2,
+              admitted=0, retired=0, drafted=8, accepted=7)
+    # plain chunk contributes NOTHING to the acceptance denominator
+    fr.record("m@1", "continuous", step_ms=1.0, chunk=4, active=2,
+              admitted=0, retired=0)
+    snap = fr.snapshot(tail=16)
+    win = snap["models"]["m@1"]["window"]
+    assert win["drafted"] == 8
+    assert win["accepted"] == 7
+    assert win["spec_acceptance"] == pytest.approx(7 / 10)
+    stats = fr.engine_stats()
+    assert stats["spec_acceptance"] == pytest.approx(7 / 10)
+    path = fr.dump("slo_breach", dedup_key=("slo", "spec"))
+    mod = _load_engine_dump_module()
+    assert mod.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "speculation: 7 tokens emitted / 8 drafted" in out
+    assert "acceptance=0.700" in out
+
 
 def test_snapshot_and_engine_stats_under_5ms_with_128_rings():
     """Read-side scaling pin: a busy multi-tenant node (128 model rings,

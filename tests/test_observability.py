@@ -589,7 +589,9 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_request_phase_seconds",
     "tpusc_requests_in_flight",
     "tpusc_scrape_errors",
+    "tpusc_spec_accepted_tokens",
     "tpusc_spec_draft_autodisabled",
+    "tpusc_spec_rounds",
     "tpusc_spec_tokens_per_round",
     "tpusc_tenant_byte_seconds",
     "tpusc_tenant_cold_load_seconds",

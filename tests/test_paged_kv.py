@@ -286,6 +286,51 @@ def test_oversized_request_fails_loudly(tmp_path):
         rt.close()
 
 
+# -- speculative rounds: ragged acceptance vs page conservation ---------------
+
+def test_spec_round_census_under_recycling_stress(tmp_path, monkeypatch):
+    """Ragged per-row acceptance must leave BOTH arenas (target + draft)
+    exactly conserved: 16 rows churn through a 6-page arena with spec
+    rounds enabled, the trash-unreachable guard armed on every chunk, and
+    the drained free-lists must hold every page exactly once. Greedy output
+    stays byte-identical to the dense spec-less engine throughout."""
+    import tfservingcache_tpu.runtime.model_runtime as mr
+
+    monkeypatch.setattr(mr, "_PAGECHECK", True)
+    ids, lens = _ragged_prompts(rows=16, width=7, seed=9)
+    rt_d, mid = _load(tmp_path / "dense")
+    eng_d = ContinuousGenerateEngine(rt_d, slots=4, chunk_tokens=4)
+    rt_p, _ = _load(tmp_path / "paged")
+    draft_cfg = dict(TINY, d_model=24, n_layers=1, n_heads=2, n_kv_heads=1,
+                     d_ff=48)
+    export_artifact("transformer_lm", str(tmp_path / "paged"), name="draft",
+                    version=1, config=draft_cfg, seed=3)
+    d_mid = ModelId("draft", 1)
+    rt_p.ensure_loaded(
+        Model(identifier=d_mid, path=str(tmp_path / "paged" / "draft" / "1"))
+    )
+    # budget per row: prompt <= 7 + max_new 6 + spec headroom 2 = 15 tokens
+    # -> 2 pages, so at most 3 rows hold target pages at once while 16 churn
+    eng_p = ContinuousGenerateEngine(rt_p, slots=4, chunk_tokens=4,
+                                     page_tokens=PT, arena_pages=6,
+                                     spec_draft_model="draft", spec_tokens=2)
+    try:
+        dense = eng_d.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+        paged = eng_p.generate(mid, ids, prompt_lengths=lens, max_new_tokens=6)
+        assert (paged == dense).all()
+        st = _slot_state(rt_p, mid)
+        assert st.spec_draft is not None
+        _assert_arena_clean(st)
+        _assert_arena_clean(st.spec_draft)
+        st.check_page_conservation()
+        st.spec_draft.check_page_conservation()
+    finally:
+        eng_d.close()
+        eng_p.close()
+        rt_d.close()
+        rt_p.close()
+
+
 # -- satellite: first-admission once-guard ------------------------------------
 
 def test_slot_state_allocated_once_under_race(tmp_path, monkeypatch):

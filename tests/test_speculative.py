@@ -424,6 +424,29 @@ def test_spec_draft_autodisable_on_low_acceptance(lm_stack, tmp_path, caplog):
     assert (big, adv) not in runtime._spec_health
 
 
+def test_spec_health_pruned_for_never_resident_models(lm_stack):
+    """Regression pin (ISSUE 16 bugfix): unload() must prune _spec_health
+    entries naming the unloaded id in EITHER role even when the model was
+    never resident on this runtime (remote-scheduler unloads route through
+    the same path), and _spec_observe must not resurrect entries for
+    non-resident pairs — otherwise every evicted draft leaks its health
+    dict forever."""
+    manager, runtime = lm_stack
+    big, ghost = ModelId("big", 1), ModelId("ghost", 1)
+    manager.ensure_servable(big)
+    entry = {"low_streak": 0, "disabled": False, "skipped": 0}
+    with runtime._spec_lock:
+        runtime._spec_health[(big, ghost)] = dict(entry)
+        runtime._spec_health[(ghost, big)] = dict(entry)
+    runtime.unload(ghost)  # never resident: must still prune both roles
+    assert (big, ghost) not in runtime._spec_health
+    assert (ghost, big) not in runtime._spec_health
+    # observing a round against a non-resident draft is a no-op (the pair
+    # may have been evicted between dispatch and observation)
+    runtime._spec_observe(big, ghost, emitted=4, rounds=2)
+    assert (big, ghost) not in runtime._spec_health
+
+
 async def test_rest_draft_bad_version_is_400(tmp_path):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager

@@ -441,6 +441,11 @@ def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
     page = jnp.take_along_axis(
         tables, jnp.clip(pos // page_tokens, 0, pps - 1)[:, None], axis=1
     )[:, 0]                                                      # (S,)
+    # past-the-table writes go to the trash page EXPLICITLY: the clip above
+    # would otherwise hand back the lane's own last slot, which is a live
+    # reserved page when the lane's budget fills the whole table (a draft
+    # scan near max_seq under spec headroom capping can get here)
+    page = jnp.where(pos // page_tokens >= pps, 0, page)
     off = pos % page_tokens
     quantized = "k_scale" in cache
 
@@ -481,6 +486,90 @@ def _paged_forward_step(params, tok, cache, tables, pos, cfg, family,
                               kernel=kernel)
         out = out.reshape(s_lanes, n_heads, 1, head_dim).astype(x.dtype)
         out = out.transpose(0, 2, 1, 3).reshape(s_lanes, 1, cfg["d_model"])
+        x = x + out @ attn["wo"]
+        x = x + _ffn_block(layer, x, cfg, family, dtype)
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+    new_cache = {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    if quantized:
+        new_cache["k_scale"] = jnp.stack(new_ks)
+        new_cache["v_scale"] = jnp.stack(new_vs)
+    return logits, new_cache
+
+
+def _paged_verify_step(params, toks, cache, tables, pos, cfg, family,
+                       page_tokens: int, kernel: bool = False):
+    """One multi-position forward (s_len=T per lane) against the paged
+    arena — the verify pass of in-engine speculative decoding. Lane ``s``'s
+    T tokens ``toks[s]`` sit at positions ``pos[s]..pos[s]+T-1``; each
+    writes its K/V row at ``tables[lane, p // page_tokens]`` offset
+    ``p % page_tokens`` (clipped to the last table slot — overshoot past
+    the reservation lands on the trash page, exactly like the decode
+    step), then all T queries attend in ONE ``paged_attention_verify``
+    call with per-position causal masks. With T == 1 the math degenerates
+    to ``_paged_forward_step`` operation-for-operation, which is what
+    keeps spec-on greedy decode token-for-token identical to spec-off.
+
+    An int8 arena quantizes each of the T new rows at write time with the
+    same per-row absmax discipline — rejected draft rows are quantization
+    junk above the accepted prefix, masked until overwritten."""
+    from tfservingcache_tpu.ops.attention import paged_attention_verify
+
+    dtype = jnp.dtype(cfg["dtype"])
+    s_lanes, t_q = toks.shape
+    n_heads, n_kv = cfg["n_heads"], cfg["n_kv_heads"]
+    head_dim = cfg["d_model"] // n_heads
+    pps = tables.shape[1]
+    positions = pos[:, None] + jnp.arange(t_q)[None, :]          # (S, T)
+    pages = jnp.take_along_axis(
+        tables, jnp.clip(positions // page_tokens, 0, pps - 1), axis=1
+    )                                                            # (S, T)
+    # past-the-table positions redirect to the trash page explicitly — the
+    # clip alone would alias them onto the lane's own LAST slot, stomping
+    # visible history when the reservation fills the whole table
+    pages = jnp.where(positions // page_tokens >= pps, 0, pages)
+    off = positions % page_tokens
+    quantized = "k_scale" in cache
+
+    x = params["embed"][toks].astype(dtype)                      # (S, T, d)
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+    for li, layer in enumerate(params["layers"]):
+        attn = jax.tree_util.tree_map(lambda w: w.astype(dtype), layer["attn"])
+        h = _rmsnorm(x, layer["ln1"])
+        q = (h @ attn["wq"]).reshape(s_lanes, t_q, n_heads, head_dim).transpose(0, 2, 1, 3)
+        k = (h @ attn["wk"]).reshape(s_lanes, t_q, n_kv, head_dim).transpose(0, 2, 1, 3)
+        v = (h @ attn["wv"]).reshape(s_lanes, t_q, n_kv, head_dim).transpose(0, 2, 1, 3)
+        q = _rope_per_example(q, positions, cfg["rope_theta"])
+        k = _rope_per_example(k, positions, cfg["rope_theta"])
+
+        # scatter the T new rows per lane: advanced indices (S, T) at arena
+        # dims 0 and 2 straddle the head slice, so the updated block is
+        # (S, T, n_kv, hd) — the natural layout of the projection
+        k_rows = k.transpose(0, 2, 1, 3)                         # (S, T, n_kv, hd)
+        v_rows = v.transpose(0, 2, 1, 3)
+        ks_arena = vs_arena = None
+        if quantized:
+            k_rows, k_s = _quantize_kv_rows(k_rows)
+            v_rows, v_s = _quantize_kv_rows(v_rows)
+            ks_arena = cache["k_scale"][li].at[pages, :, off].set(k_s)
+            vs_arena = cache["v_scale"][li].at[pages, :, off].set(v_s)
+            new_ks.append(ks_arena)
+            new_vs.append(vs_arena)
+        k_arena = cache["k"][li].at[pages, :, off, :].set(
+            k_rows.astype(cache["k"].dtype)
+        )
+        v_arena = cache["v"][li].at[pages, :, off, :].set(
+            v_rows.astype(cache["v"].dtype)
+        )
+        new_k.append(k_arena)
+        new_v.append(v_arena)
+
+        out = paged_attention_verify(
+            q, k_arena, v_arena, tables, pos, page_tokens,
+            k_scale=ks_arena, v_scale=vs_arena, kernel=kernel,
+        )
+        out = out.reshape(s_lanes, n_heads, t_q, head_dim).astype(x.dtype)
+        out = out.transpose(0, 2, 1, 3).reshape(s_lanes, t_q, cfg["d_model"])
         x = x + out @ attn["wo"]
         x = x + _ffn_block(layer, x, cfg, family, dtype)
     x = _rmsnorm(x, params["ln_f"])

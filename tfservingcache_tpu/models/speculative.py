@@ -32,7 +32,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from tfservingcache_tpu.models.generation import _forward_cached_dyn, init_cache
+from tfservingcache_tpu.models.generation import (
+    _forward_cached_dyn,
+    _paged_forward_step,
+    _paged_verify_step,
+    _sample_per_row,
+    init_cache,
+)
 
 
 def _greedy(logits) -> jax.Array:
@@ -280,6 +286,126 @@ def _speculative_from_cache_jit(
         )
         return out, rounds, cache_t["k"], cache_t["v"]
     return out, rounds
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg_t_key", "cfg_d_key", "family_t", "family_d", "spec",
+        "page_tokens", "kernel",
+    ),
+    donate_argnums=(2, 3, 4, 5, 6, 7),
+)
+def _paged_spec_round_jit(  # static-bounded: cfg_t_key, cfg_d_key, family_t, family_d, spec, page_tokens, kernel -- one value per (target, draft) model pair (config/family), spec is clamped to {1,2,4,8} at attach, page_tokens is ServingConfig kv_page_tokens, kernel is a boolean
+    params_t,
+    params_d,
+    t_k,                 # target arena (layers, n_pages, n_kv, pt, hd) — donated
+    t_v,
+    t_scales,            # {"k","v"} int8 per-row scales | None — donated
+    d_k,                 # draft arena — donated
+    d_v,
+    d_scales,
+    t_tables,            # (S, pps_t) i32 target block tables
+    d_tables,            # (S, pps_d) i32 draft block tables
+    tok,                 # (S,) carry token per lane (at position pos, unwritten)
+    pos,                 # (S,) i32 write position per lane
+    active,              # (S,) bool — frozen for the whole round
+    rng,                 # (2,) uint32 — one key per round
+    temperature,         # (S,) f32 per-lane
+    top_k,               # (S,) i32 per-lane
+    *,
+    cfg_t_key,
+    cfg_d_key,
+    family_t: str,
+    family_d: str,
+    spec: int,
+    page_tokens: int,
+    kernel: bool = False,
+):
+    """One speculative round for EVERY lane of the continuous engine: the
+    draft proposes ``spec`` greedy tokens per lane (a spec+1-step paged
+    scan over its own arena — the extra step writes d_spec's K/V row so
+    full acceptance leaves no hole, same reasoning as ``_spec_decode_loop``),
+    then ONE multi-position target forward verifies all spec+1 positions
+    and each lane accepts a variable-length prefix.
+
+    Per-row accept counts are TRACED data — ``accept`` comes back as an
+    (S,) array and ``pos`` advances by it in-graph — so every acceptance
+    pattern reuses this single program (the PR 3 per-row-sampling
+    discipline; the executable-count guard test pins it). Non-greedy lanes
+    (temperature > 0) degrade IN-GRAPH to 1-token decode: their accept
+    count is forced to 0 and their emitted token is sampled from the
+    verify pass's position-0 logits — exactly the token the plain chunk
+    would have produced, under the same per-row sampling math.
+
+    Rollback is the paged arena's mask discipline verbatim: rejected-
+    suffix rows in both caches sit above the new ``pos`` and are
+    overwritten write-before-read by the next round's first write at the
+    carry position. Returns (t_k, t_v, t_scales, d_k, d_v, d_scales,
+    tok', pos', toks (S, spec+1), accept (S,)) where lane ``s`` emits
+    ``toks[s, :accept[s]]`` this round (accept = a+1 for active lanes,
+    0 for frozen ones)."""
+    cfg_t = dict(cfg_t_key)
+    cfg_d = dict(cfg_d_key)
+
+    cache_t = {"k": t_k, "v": t_v}
+    if t_scales is not None:
+        cache_t["k_scale"] = t_scales["k"]
+        cache_t["v_scale"] = t_scales["v"]
+    cache_d = {"k": d_k, "v": d_v}
+    if d_scales is not None:
+        cache_d["k_scale"] = d_scales["k"]
+        cache_d["v_scale"] = d_scales["v"]
+
+    def draft_step(c, _):
+        cache_d, tk, p = c
+        logits, cache_d = _paged_forward_step(
+            params_d, tk, cache_d, d_tables, p, cfg_d, family_d,
+            page_tokens, kernel=kernel,
+        )
+        nxt = _greedy(logits[:, 0])
+        return (cache_d, nxt, p + 1), nxt
+
+    (cache_d, _, _), d_toks = jax.lax.scan(
+        draft_step, (cache_d, tok, pos), None, length=spec + 1
+    )
+    d = jnp.transpose(d_toks[:spec], (1, 0))                # (S, spec)
+
+    # one multi-position target forward scores all spec+1 positions:
+    # logits_t[:, j] predicts position pos+1+j
+    chunk = jnp.concatenate([tok[:, None], d], axis=1)      # (S, spec+1)
+    logits_t, cache_t = _paged_verify_step(
+        params_t, chunk, cache_t, t_tables, pos, cfg_t, family_t,
+        page_tokens, kernel=kernel,
+    )
+    g = _greedy(logits_t)                                   # (S, spec+1)
+    matches = (d == g[:, :spec]).astype(jnp.int32)
+    a = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)       # (S,) 0..spec
+
+    # greedy rows emit g[:, :a+1] (for j < a, d_j == g_j so the target-
+    # greedy rows ARE the emitted stream); non-greedy rows accept nothing
+    # and emit one token sampled from the position-0 logits — identical
+    # math to the plain chunk's _sample_per_row step
+    greedy_row = temperature <= 0.0
+    e0 = _sample_per_row(logits_t[:, 0], rng, temperature, top_k)
+    a = jnp.where(greedy_row, a, 0)
+    toks = g.at[:, 0].set(jnp.where(greedy_row, g[:, 0], e0))
+    accept = jnp.where(active, a + 1, 0)                    # emitted count
+    carry = jnp.take_along_axis(toks, a[:, None], axis=1)[:, 0]
+    tok = jnp.where(active, carry, tok)
+    pos = pos + accept
+
+    t_scales = (
+        {"k": cache_t["k_scale"], "v": cache_t["v_scale"]}
+        if t_scales is not None else None
+    )
+    d_scales = (
+        {"k": cache_d["k_scale"], "v": cache_d["v_scale"]}
+        if d_scales is not None else None
+    )
+    return (cache_t["k"], cache_t["v"], t_scales,
+            cache_d["k"], cache_d["v"], d_scales,
+            tok, pos, toks, accept)
 
 
 def speculative_generate(

@@ -564,6 +564,54 @@ def paged_decode_attention(
     return out.reshape(s_lanes, hq, 1, d)
 
 
+def paged_verify_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    page_tokens: int,
+) -> jax.Array:
+    """Multi-token-query attention over a paged KV arena — the verify pass
+    of in-engine speculative decoding (ISSUE 16).
+
+    Shapes: q ``(S, Hq, T, D)`` (T = spec_tokens + 1 query positions per
+    lane, post-RoPE), k_pages/v_pages ``(n_pages, Hkv, page_tokens, D)``,
+    tables ``(S, pages_per_slot)`` int32, pos ``(S,)`` int32 positions of
+    each lane's FIRST query token. Returns f32 ``(S, Hq, T, D)``.
+
+    Query index ``t`` of lane ``s`` sits at position ``pos[s] + t`` and
+    attends with the causal mask ``k_pos <= pos[s] + t`` — with T == 1 this
+    degenerates exactly to ``paged_decode_attention``'s mask, and the math
+    below mirrors it operation-for-operation (GQA grouped K/V, f32
+    accumulation, probs cast to the cache dtype) so the two paths are
+    parity-exact over the shared positions. The caller has already
+    scattered the T draft K/V rows into the lane's PRIVATE pages at
+    ``pos..pos+T-1``; rows above the eventually-accepted prefix are junk a
+    later round overwrites — same discipline as the solo verify chunk."""
+    s_lanes, hq, t, d = q.shape
+    hkv = k_pages.shape[1]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    g = hq // hkv
+    kc = paged_gather_kv(k_pages, tables, page_tokens)   # (S, Hkv, L, D)
+    vc = paged_gather_kv(v_pages, tables, page_tokens)
+    qg = q.reshape(s_lanes, hkv, g, t, d)
+    s = jnp.einsum(
+        "bkgqd,bkld->bkgql", qg, kc, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    k_pos = jnp.arange(kc.shape[2])
+    q_pos = pos[:, None] + jnp.arange(t)[None, :]        # (S, T)
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]     # (S, T, L)
+    s = jnp.where(mask[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bkgql,bkld->bkgqd", p.astype(vc.dtype), vc,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(s_lanes, hq, t, d)
+
+
 def dequantize_pages(pages: jax.Array, scales: jax.Array) -> jax.Array:
     """Expand an int8 page arena ``(n_pages, Hkv, page_tokens, D)`` against
     its per-(page, head, token) f32 scales ``(n_pages, Hkv, page_tokens)``
@@ -813,4 +861,221 @@ def paged_attention(  # static-bounded: kernel, page_tokens, PAGED_KERNEL_INTERP
         k_pages = dequantize_pages(k_pages, k_scale)
         v_pages = dequantize_pages(v_pages, v_scale)
     return paged_decode_attention(q, k_pages, v_pages, tables, pos,
+                                  page_tokens)
+
+
+def _paged_verify_kernel(
+    tables_ref, pos_ref, q_ref, k_ref, v_ref, *rest, sm_scale: float,
+    page_tokens: int, num_pages: int, num_queries: int, group: int,
+    quantized: bool,
+):
+    """One (lane, kv-head, table-slot) grid step of paged VERIFY attention.
+
+    Same streaming skeleton as ``_paged_decode_kernel``, but the query
+    block carries T query positions folded into the row axis — row ``r``
+    of the ``(T*g, d)`` block is query offset ``r // g`` of the lane, at
+    position ``pos + r // g``. One extra iota-compare per page gives each
+    row its own causal frontier, so the T-position verify pass of a spec
+    round streams the arena exactly ONCE instead of T times. Visibility
+    extends to the page holding ``pos + T - 1`` (the draft rows the caller
+    just scattered); rows whose frontier ends earlier simply mask the
+    whole page — at j == 0 every row sees k_pos 0, so the online-softmax
+    max is finite from the first step and fully-masked later pages
+    contribute exp(NEG_INF - finite) == 0, never NaN."""
+    from jax.experimental import pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_s, m_s, l_s = rest
+    else:
+        o_ref, acc_s, m_s, l_s = rest
+
+    s_i = pl.program_id(0)
+    j = pl.program_id(2)
+    pos = pos_ref[s_i]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_s[...] = jnp.zeros_like(acc_s)
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+
+    # a table slot is live iff ANY query row can see it: the deepest
+    # frontier is pos + T - 1 (the last draft row, written this round)
+    @pl.when(j <= (pos + num_queries - 1) // page_tokens)
+    def _body():
+        q = q_ref[0, 0]                                     # (T*g, d)
+        k = k_ref[0, 0]                                     # (pt, d)
+        v = v_ref[0, 0]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
+            q = q.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale                                        # (T*g, pt) f32
+        k_pos = j * page_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        # row r is query offset r // g: per-row causal frontier pos + r//g
+        q_off = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        s = jnp.where(k_pos <= pos + q_off, s, NEG_INF)
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_s[...] = acc_s[...] * alpha + pv
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(j == num_pages - 1)
+    def _final():
+        o_ref[0, 0] = (
+            acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_tokens", "interpret"))
+def paged_verify_attention_kernel(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    *,
+    page_tokens: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused paged verify attention: same contract as
+    ``paged_verify_attention`` (q ``(S, Hq, T, D)``, arena pages, tables,
+    pos -> f32 ``(S, Hq, T, D)``) with one pass over the KV bytes. The T
+    query positions fold into the GQA group axis — blocks become
+    ``(T*g, d)`` with row ``r`` at query offset ``r // g`` — so the grid,
+    index maps, and scalar-prefetch discipline are identical to
+    ``paged_decode_attention_kernel`` and T never becomes a grid dim.
+    T is a shape, not a static arg: one program per (config, spec_tokens)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s_lanes, hq, t_q, d = q.shape
+    n_pages_arena, hkv, pt, _ = k_pages.shape
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if pt != page_tokens:
+        raise ValueError(f"arena page_tokens {pt} != {page_tokens}")
+    g = hq // hkv
+    pps = tables.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    quantized = k_scale is not None
+
+    # (S, Hq, T, D) -> (S, hkv, T*g, d) with row r = t*g + gi, so the
+    # kernel recovers the query offset as r // g
+    qg = (
+        q.reshape(s_lanes, hkv, g, t_q, d)
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(s_lanes, hkv, t_q * g, d)
+    )
+    tables = tables.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+
+    def q_index(s, h, j, tbl, ps):
+        return (s, h, 0, 0)
+
+    def kv_index(s, h, j, tbl, ps):
+        # the last live page now holds pos + T - 1 (draft rows written
+        # this round); clamp dead trailing slots to it, same elision as
+        # the decode kernel
+        jj = jnp.minimum(j, (ps[s] + t_q - 1) // page_tokens)
+        return (tbl[s, jj], h, 0, 0)
+
+    def scale_index(s, h, j, tbl, ps):
+        jj = jnp.minimum(j, (ps[s] + t_q - 1) // page_tokens)
+        return (tbl[s, jj], h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, t_q * g, d), q_index),
+        pl.BlockSpec((1, 1, pt, d), kv_index),
+        pl.BlockSpec((1, 1, pt, d), kv_index),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, 1, pt), scale_index),
+            pl.BlockSpec((1, 1, pt), scale_index),
+        ]
+        operands += [k_scale, v_scale]
+
+    kernel = functools.partial(
+        _paged_verify_kernel, sm_scale=sm_scale, page_tokens=page_tokens,
+        num_pages=pps, num_queries=t_q, group=g, quantized=quantized,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_lanes, hkv, pps),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, t_q * g, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((t_q * g, d), jnp.float32),      # acc
+            pltpu.VMEM((t_q * g, 128), jnp.float32),    # m (lane-bcast)
+            pltpu.VMEM((t_q * g, 128), jnp.float32),    # l (lane-bcast)
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (s_lanes, hkv, t_q * g, d), jnp.float32
+        ),
+        interpret=interpret,
+        compiler_params=_tpu_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary")
+        ),
+    )(tables, pos, *operands)
+    return (
+        out.reshape(s_lanes, hkv, t_q, g, d)
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(s_lanes, hq, t_q, d)
+    )
+
+
+def paged_attention_verify(  # static-bounded: kernel, page_tokens, PAGED_KERNEL_INTERPRET -- kernel and the interpret flag are booleans (two programs max); page_tokens is one value per slot state (ServingConfig kv_page_tokens)
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pos: jax.Array,
+    page_tokens: int,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    kernel: bool = True,
+) -> jax.Array:
+    """Multi-token-query (verify) dispatch with exactly ``paged_attention``'s
+    gate: fused Pallas kernel on TPU backends when shapes qualify, the
+    gather+einsum reference elsewhere, ``kernel=False`` forcing the
+    reference unconditionally. Per-row acceptance downstream is traced
+    data; only (config, spec_tokens) mints programs here."""
+    if kernel and (
+        PAGED_KERNEL_INTERPRET
+        or (
+            jax.default_backend() in TPU_BACKENDS
+            and q.shape[-1] % 64 == 0
+            and q.shape[1] % k_pages.shape[1] == 0
+        )
+    ):
+        return paged_verify_attention_kernel(
+            q, k_pages, v_pages, tables, pos, k_scale, v_scale,
+            page_tokens=page_tokens, interpret=PAGED_KERNEL_INTERPRET,
+        )
+    if k_scale is not None:
+        k_pages = dequantize_pages(k_pages, k_scale)
+        v_pages = dequantize_pages(v_pages, v_scale)
+    return paged_verify_attention(q, k_pages, v_pages, tables, pos,
                                   page_tokens)

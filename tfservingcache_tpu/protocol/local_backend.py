@@ -76,8 +76,16 @@ class LocalServingBackend(ServingBackend):
         kv_share_prefix_bytes: int = 0,
         kv_paged_kernel: bool = True,
         kv_arena_dtype: str = "",
+        spec_draft_model: str = "",
+        spec_tokens: int = 4,
     ) -> None:
         self.manager = manager
+        # engine-level speculative decoding: the continuous scheduler needs
+        # the draft RESIDENT to attach it, and residency is the backend's
+        # job (the engine has no ensure_servable) — _rest_generate ensure-
+        # loads this name alongside the target when the continuous engine
+        # is in play (set below; "" everywhere else)
+        self._spec_draft_name = ""
         # JAX dispatch is effectively serialized per device; a few workers
         # keep fetch/compile of different models overlapping inference.
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="tpusc-serve")
@@ -125,7 +133,10 @@ class LocalServingBackend(ServingBackend):
                 share_prefix_bytes=kv_share_prefix_bytes,
                 arena_dtype=kv_arena_dtype,
                 paged_kernel=kv_paged_kernel,
+                spec_draft_model=spec_draft_model,
+                spec_tokens=spec_tokens,
             )
+            self._spec_draft_name = str(spec_draft_model or "")
 
     async def _run(self, fn, *args):
         # copy_context: the executor job joins the request's ambient trace
@@ -659,6 +670,24 @@ class LocalServingBackend(ServingBackend):
             if draft_mid is not None:
                 self._ensure_sync(draft_mid)
             gen = self._generator
+            if (
+                gen is not None and draft_mid is None
+                and self._spec_draft_name
+                and self._spec_draft_name.partition("@")[0] != model_id.name
+            ):
+                # engine-level spec (serving.spec_draft_model): the
+                # continuous scheduler attaches the draft only while it is
+                # RESIDENT, so ensure it here alongside the target.
+                # Best-effort: a missing/evicted draft degrades to plain
+                # decode, it never fails the target's request.
+                base, _, ver = self._spec_draft_name.partition("@")
+                try:
+                    d_ver = self.manager.resolve_version(
+                        base, int(ver) if ver else None
+                    )
+                    self._ensure_sync(ModelId(base, d_ver))
+                except Exception:  # noqa: BLE001 - spec is an optimization
+                    pass
             try:
                 # inside the try: malformed params ("max_new_tokens": "abc")
                 # must be a 400, not an unhandled 500

@@ -46,6 +46,7 @@ import numpy as np
 
 from tfservingcache_tpu.runtime.base import (
     BaseRuntime,
+    ModelNotLoadedError,
     RuntimeError_,
 )
 from tfservingcache_tpu.types import ModelId
@@ -703,6 +704,12 @@ class _ContinuousScheduler:
         self.cv = threading.Condition()
         self.pending: collections.deque[_ContinuousReq] = collections.deque()
         self.stopped = False
+        # speculative decoding (ISSUE 16): set when the configured draft
+        # pair turned out structurally incompatible (family/vocab/dense) —
+        # permanent for this scheduler, so the warning logs once and every
+        # later boundary decodes plain without re-raising. Scheduler-thread
+        # only, like `lanes`/`state`.
+        self._spec_broken = False
         self.thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"tpusc-cdecode-{model_id.name}",
@@ -725,6 +732,79 @@ class _ContinuousScheduler:
             if r.error is None and not r.done.is_set():
                 r.error = err
                 r.done.set()
+
+    def _resolve_draft_id(self, rt, name: str) -> ModelId | None:
+        """Map the spec_draft_model knob ("name" or "name@version") to a
+        RESIDENT ModelId, newest version first for a bare name. None when
+        nothing resident matches — the scheduler just retries next boundary
+        (the backend ensure-loads the draft on the generate path, so the
+        first boundary after that load attaches)."""
+        if "@" in name:
+            base, _, ver = name.rpartition("@")
+            try:
+                want = ModelId(base, int(ver))
+            except ValueError:
+                return None
+            return want if rt.is_loaded(want) else None
+        best = None
+        for mid in rt.resident_models():
+            if mid.name == name and (best is None or mid.version > best.version):
+                best = mid
+        return best
+
+    def _spec_setup(self, rt, state, lanes) -> None:
+        """Attach (or detach) the configured draft model on this scheduler's
+        slot state. Attach only happens with every lane idle: rows admitted
+        while the draft is attached reserve + prefill BOTH arenas, so a
+        mid-flight attach would leave live lanes with no draft pages and the
+        draft-side page census would see active lanes mapping trash."""
+        eng = self.engine
+        st_draft = getattr(state, "spec_draft", None)
+        if st_draft is not None:
+            # keep the pair only while the draft stays resident; on
+            # eviction detach and fall back to plain chunks (re-attach
+            # happens at the next all-idle boundary if it reloads)
+            if not rt.is_loaded(state.spec_draft_id):
+                state.spec_draft = None
+                state.spec_draft_id = None
+                state.spec_tokens = 0
+            return
+        if self._spec_broken or state is None:
+            return
+        if not getattr(state, "paged", False):
+            return
+        if not hasattr(rt, "slot_attach_draft"):
+            return
+        name = eng.spec_draft_model
+        if name is None:
+            name = str(
+                getattr(getattr(rt, "cfg", None), "spec_draft_model", "") or ""
+            )
+        if not name:
+            return
+        if any(l is not None for l in lanes):
+            return
+        draft_id = self._resolve_draft_id(rt, name)
+        if draft_id is None or draft_id == self.model_id:
+            return
+        spec = eng.spec_tokens
+        if spec is None:
+            spec = int(getattr(getattr(rt, "cfg", None), "spec_tokens", 4) or 4)
+        try:
+            rt.slot_attach_draft(state, draft_id, spec)
+            log.info(
+                "continuous spec attach model=%s draft=%s spec_tokens=%d",
+                self.model_id, draft_id, state.spec_tokens,
+            )
+        except ModelNotLoadedError:
+            # evicted between resolve and attach: transient, retry later
+            pass
+        except RuntimeError_ as e:
+            self._spec_broken = True
+            log.warning(
+                "continuous spec disabled model=%s draft=%s: %s",
+                self.model_id, draft_id, e,
+            )
 
     def _loop(self) -> None:
         rt = self.engine.runtime
@@ -779,6 +859,11 @@ class _ContinuousScheduler:
         step_t0 = time.monotonic()
         eos = getattr(rt, "eos_id_of", lambda _m: None)(self.model_id)
         free = [i for i, l in enumerate(lanes) if l is None]
+        if state is not None:
+            # draft attach/detach happens at the boundary, before admission,
+            # so every row admitted below sees the final spec configuration
+            # (page budgets include draft headroom iff the draft is on)
+            self._spec_setup(rt, state, lanes)
         admitted_any = False
         admitted_n = 0
         retired_n = 0
@@ -793,6 +878,8 @@ class _ContinuousScheduler:
                 if eng.metrics is not None:
                     eng.metrics.batcher_queue_depth.labels("generate").dec()
             reserved_idx = None
+            d_st = None
+            d_pk = d_pv = None
             try:
                 if state is None:
                     if eng.page_tokens is None and \
@@ -816,6 +903,10 @@ class _ContinuousScheduler:
                         state = rt.slot_decode_state(
                             self.model_id, eng.slots, **kw
                         )
+                    # fresh state: every lane is idle, so the draft (if
+                    # configured and resident) can attach right away
+                    self._spec_setup(rt, state, lanes)
+                d_st = getattr(state, "spec_draft", None)
                 p = req.prompt.shape[0]
                 if p + req.max_new > state.max_seq:
                     req.error = RuntimeError_(
@@ -830,8 +921,16 @@ class _ContinuousScheduler:
                 if getattr(state, "paged", False):
                     # admission is gated on free PAGES, not just free lanes:
                     # the row's whole prompt + max_new budget is reserved up
-                    # front so a mid-decode row can never starve for a page
-                    budget = p + req.max_new
+                    # front so a mid-decode row can never starve for a page.
+                    # With a draft attached the budget grows by spec_tokens
+                    # of headroom — a verify round started one token short
+                    # of max_new still writes K/V rows at pos..pos+spec, and
+                    # those writes must land on pages this row owns (never
+                    # shared/trash), so the overshoot is reserved up front
+                    # and handed back through release_pages at retirement.
+                    headroom = state.spec_tokens if d_st is not None else 0
+                    budget = min(p + req.max_new + headroom,
+                                 state.pages_per_slot * state.page_tokens)
                     need = state.pages_needed(budget)
                     if need > state.arena_pages:
                         req.error = RuntimeError_(
@@ -871,6 +970,21 @@ class _ContinuousScheduler:
                             ok = state.reserve_pages(
                                 idx, budget, shared_pages, cow_headroom
                             )
+                    if ok and d_st is not None:
+                        # the draft arena mirrors the reservation (its rows
+                        # for pos..pos+spec are written every round). No
+                        # shared pages: the draft state has no prefix index,
+                        # every draft page is private by construction. The
+                        # cap keeps a shorter draft max_seq from deadlocking
+                        # (the auto-sized draft arena always covers slots x
+                        # pages_per_slot, so a capped reservation succeeds
+                        # whenever the lane itself is free).
+                        d_budget = min(
+                            budget, d_st.pages_per_slot * d_st.page_tokens
+                        )
+                        if not d_st.reserve_pages(idx, d_budget):
+                            state.release_pages(idx)
+                            ok = False
                     if not ok:
                         # arena exhausted: the queue BLOCKS, never fails —
                         # the row goes back to the FRONT (FIFO preserved)
@@ -906,12 +1020,22 @@ class _ContinuousScheduler:
                         req.top_k, seed=seed,
                     )
                     last = None
+                if d_st is not None and reserved_idx is not None:
+                    # greedy draft prefill (temperature 0, sampled token
+                    # ignored — only the draft's K/V rows matter). Runs even
+                    # on an exact target prefix hit: the draft arena has no
+                    # prefix index to skip into.
+                    _, d_pk, d_pv, _ = rt.slot_prefill(
+                        state.spec_draft_id, req.prompt, 0.0, 0, seed=seed,
+                    )
             except BaseException as e:  # noqa: BLE001
                 # the req is already out of `pending` and not yet in `lanes`
                 # — without this the _loop doom sweep would miss it and its
                 # waiter would block until timeout
                 if reserved_idx is not None:
                     state.release_pages(reserved_idx)
+                    if d_st is not None:
+                        d_st.release_pages(reserved_idx)
                 self._fail([req], e)
                 raise
             now = time.monotonic()
@@ -965,6 +1089,10 @@ class _ContinuousScheduler:
                 # publish this lane's prompt pages so later same-prefix
                 # admissions share them (exact hits are already indexed)
                 rt.shared_prefix_publish(state, idx, req.prompt, last)
+            if d_pk is not None:
+                # the draft lane rides the same index: its prompt K/V lands
+                # on the pages reserved above, all private
+                rt.slot_admit(d_st, idx, d_pk, d_pv)
             state.tok[idx] = int(tok)
             state.pos[idx] = p
             state.active[idx] = True
@@ -993,6 +1121,21 @@ class _ContinuousScheduler:
         )
         chunk = max(1, min(eng.chunk_tokens, _next_bucket(max_remaining)))
         active_rows = sum(l is not None for l in lanes)
+        d_st = getattr(state, "spec_draft", None)
+        use_spec = (
+            d_st is not None
+            and rt.is_loaded(state.spec_draft_id)
+            and getattr(rt, "_spec_admit", lambda *_a: False)(
+                self.model_id, state.spec_draft_id
+            )
+            # a round with zero greedy lanes is pure draft overhead (every
+            # sampled row forces accept=1), so it falls back to plain decode
+            and any(
+                l is not None and float(state.temps[i]) <= 0.0
+                for i, l in enumerate(lanes)
+            )
+        )
+        spec_span = state.spec_tokens if use_spec else 0
         if getattr(state, "paged", False) and \
                 getattr(state, "page_refs", None) is not None:
             # copy-on-write safety net: no lane may write into a page it
@@ -1000,31 +1143,67 @@ class _ContinuousScheduler:
             # write target (the exact-hit boundary page) and a chunk only
             # advances into the lane's own private reservation, so this
             # never fires in the designed protocol — it is the refcount
-            # invariant's last line of defense, not a fast path.
+            # invariant's last line of defense, not a fast path. A spec
+            # round writes K/V rows at pos..pos+spec in one dispatch, so
+            # the net covers every page that span touches, not just pos's.
             for cidx, creq in enumerate(lanes):
                 if creq is None:
                     continue
-                slot = int(state.pos[cidx]) // state.page_tokens
-                if slot < state.pages_per_slot:
+                first = int(state.pos[cidx]) // state.page_tokens
+                last = min(
+                    (int(state.pos[cidx]) + spec_span) // state.page_tokens,
+                    state.pages_per_slot - 1,
+                )
+                for slot in range(first, last + 1):
                     pg = int(state.block_tables[cidx, slot])
                     if pg and int(state.page_refs[pg]) > 1:
                         rt.slot_cow(state, cidx, slot)
-        toks = rt.slot_decode_chunk(state, chunk)
+        accept = None
+        if use_spec:
+            try:
+                toks, accept = rt.slot_decode_spec_round(state)
+            except ModelNotLoadedError as e:
+                if rt.is_loaded(self.model_id):
+                    # the draft was evicted between the residency check and
+                    # the round: detach and decode plain — target lanes are
+                    # untouched (the round failed before any state update)
+                    log.info(
+                        "continuous spec detach model=%s (%s)",
+                        self.model_id, e,
+                    )
+                    state.spec_draft = None
+                    state.spec_draft_id = None
+                    state.spec_tokens = 0
+                else:
+                    raise
+        if accept is None:
+            toks = rt.slot_decode_chunk(state, chunk)
+        else:
+            # ring/ledger semantics: a spec round can emit up to spec+1
+            # tokens per lane in one dispatch — that is its "chunk"
+            chunk = state.spec_tokens + 1
         eng.chunks += 1
         now = time.monotonic()
         wasted = 0
+        drafted = spec_span * active_rows if accept is not None else 0
+        accepted = int(accept.sum()) if accept is not None else 0
         for idx, req in enumerate(lanes):
             if req is None:
                 continue
-            for j in range(chunk):
+            # spec rounds emit a VARIABLE per-row prefix (the accepted
+            # draft run + the verify's correction token); plain chunks
+            # emit exactly `chunk` tokens per live lane
+            n_emit = chunk if accept is None else int(accept[idx])
+            for j in range(n_emit):
                 t = int(toks[idx, j])
                 req.tokens.append(t)
                 if (eos is not None and t == eos) or len(req.tokens) >= req.max_new:
                     # retire NOW: steps the chunk computed past this point
                     # were for a finished request — the waste continuous
                     # batching exists to bound (< chunk, vs batch-drain
-                    # padding under coalesce)
-                    wasted += chunk - (j + 1)
+                    # padding under coalesce). Under spec this also drops
+                    # accepted tokens past a mid-round EOS.
+                    wasted += n_emit - (j + 1)
                     state.active[idx] = False
                     lanes[idx] = None
                     if getattr(state, "paged", False):
@@ -1035,17 +1214,27 @@ class _ContinuousScheduler:
                     break
         if wasted and eng.metrics is not None:
             eng.metrics.gen_wasted_steps.labels("continuous").inc(wasted)
+        if accept is not None and hasattr(rt, "_spec_observe"):
+            # acceptance health + cumulative counters: one verify round per
+            # active lane this boundary
+            rt._spec_observe(
+                self.model_id, state.spec_draft_id, accepted, active_rows,
+                engine="continuous",
+            )
         eng._set_active(self.model_id, sum(l is not None for l in lanes))
         self._update_page_gauge(state)
         self._record_step(
             state, chunk, active_rows, admitted_n, retired_n, wasted, step_t0,
             prefix_hits_n, prefill_s_sum, tokens_in_n,
+            drafted=drafted, accepted=accepted,
+            emitted=accepted if accept is not None else None,
         )
         return state
 
     def _record_step(
         self, state, chunk, active, admitted, retired, wasted, step_t0,
         prefix_hits=0, prefill_s=0.0, tokens_in=0,
+        drafted=0, accepted=0, emitted=None,
     ) -> None:
         """One flight-recorder ring entry per chunk boundary, plus the
         oldest-queued-age gauge (`gen_admission_wait` only observes at
@@ -1078,7 +1267,11 @@ class _ContinuousScheduler:
             prefill_s=prefill_s,
             decode_s=max(0.0, (now - step_t0) - prefill_s),
             tokens_in=tokens_in,
-            tokens_out=admitted + max(0, active * chunk - wasted),
+            # spec rounds pass the true emitted total (variable per-row
+            # acceptance); plain chunks emit exactly chunk per live lane
+            tokens_out=admitted + max(
+                0, (active * chunk if emitted is None else emitted) - wasted
+            ),
             queue_depth=depth,
         )
         RECORDER.record(
@@ -1091,6 +1284,7 @@ class _ContinuousScheduler:
             pages_free=len(state.free_pages) if paged else 0,
             wasted=wasted, queue_depth=depth, oldest_wait_ms=wait_ms,
             pages_shared=shared, prefix_hits=prefix_hits,
+            drafted=drafted, accepted=accepted,
         )
 
     def _retire_pages(self, state, idx: int, req: _ContinuousReq) -> None:
@@ -1104,6 +1298,12 @@ class _ContinuousScheduler:
             used = req.prompt.shape[0] + len(req.tokens)
             eng.metrics.gen_kv_page_waste.observe(max(0, cap - min(used, cap)))
         state.release_pages(idx)
+        d_st = getattr(state, "spec_draft", None)
+        if d_st is not None:
+            # the draft lane retires with its target: whole-page overshoot
+            # from the last verify round hands back through the same
+            # free-list, keeping the draft-side conservation census exact
+            d_st.release_pages(idx)
 
     def _update_page_gauge(self, state) -> None:
         if state is not None and getattr(state, "paged", False):
@@ -1164,6 +1364,8 @@ class ContinuousGenerateEngine:
         share_prefix_bytes: int | None = None,
         arena_dtype: str | None = None,
         paged_kernel: bool | None = None,
+        spec_draft_model: str | None = None,
+        spec_tokens: int | None = None,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
@@ -1188,6 +1390,17 @@ class ContinuousGenerateEngine:
         self.paged_kernel = (
             None if paged_kernel is None else bool(paged_kernel)
         )
+        # in-engine speculative decoding (ISSUE 16): None = defer to the
+        # runtime's ServingConfig (serving.spec_draft_model /
+        # serving.spec_tokens), "" = explicitly off.  The draft model is
+        # named "name" (highest resident version) or "name@version"; each
+        # scheduler attaches it to its slot state via slot_attach_draft and
+        # replaces plain decode chunks with draft/verify rounds whenever the
+        # health gate (_spec_admit) allows.
+        self.spec_draft_model = (
+            None if spec_draft_model is None else str(spec_draft_model)
+        )
+        self.spec_tokens = None if spec_tokens is None else int(spec_tokens)
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}
         self._active: dict[ModelId, int] = {}

@@ -521,6 +521,16 @@ class SlotDecodeState:
     # trash (page 0), or refs > 0.
     page_refs: np.ndarray | None = None      # (arena_pages + 1,) i32
     prefix_index: Any = None                 # PagePrefixIndex | None
+    # -- in-engine speculative decoding (ISSUE 16): the draft model's own
+    # SlotDecodeState rides on the target's — same slot count and
+    # page_tokens, its own arena/tables/free-list/census, no prefix index —
+    # so every scheduler reserve/release call mirrors 1:1 onto the draft
+    # arena and both censuses stay exact. The draft state's tok/pos/active
+    # host mirrors alias the target's (identical by construction: both
+    # caches advance through the same accepted positions). None = spec off.
+    spec_draft_id: Any = None        # ModelId of the attached draft
+    spec_draft: Any = None           # the draft's SlotDecodeState
+    spec_tokens: int = 0             # draft proposals per verify round
 
     @property
     def paged(self) -> bool:
@@ -2217,6 +2227,124 @@ class TPUModelRuntime(BaseRuntime):
         state.pos = np.array(jax.device_get(pos), dtype=np.int32)
         return np.asarray(jax.device_get(toks))
 
+    def slot_attach_draft(self, state: SlotDecodeState, draft_id: ModelId,
+                          spec_tokens: int = 4) -> SlotDecodeState:
+        """Attach ``draft_id``'s decode state to ``state`` for in-engine
+        speculative rounds (runtime/batcher.py under serving.spec_draft_model):
+        builds the draft's own paged arena with the target's slot count and
+        page size — auto-sized, quantized and kernel-gated exactly like the
+        target's — and pins it on ``state.spec_draft`` so its lifecycle is
+        the target state's (dropped together; NOT registered in
+        ``_slot_states``). Idempotent for the same draft. The draft must be
+        resident, share the target's vocabulary, and be a transformer_lm;
+        the target state must be paged (the private-page discipline is what
+        makes ragged rollback free). ``spec_tokens`` is clamped to the same
+        {1,2,4,8} jit-signature buckets as the solo path."""
+        if state.spec_draft is not None and state.spec_draft_id == draft_id:
+            return state.spec_draft
+        if not state.paged:
+            raise RuntimeError_(
+                "in-engine speculation requires a paged slot state "
+                "(serving.kv_page_tokens > 0)"
+            )
+        loaded = self._resident.get(state.model_id)
+        draft = self._resident.get(draft_id)
+        if loaded is None or draft is None:
+            missing = state.model_id if loaded is None else draft_id
+            raise ModelNotLoadedError(f"model {missing} is not loaded")
+        if draft.model_def.family != "transformer_lm":
+            raise RuntimeError_(
+                "continuous speculation supports transformer_lm drafts "
+                f"only, not {draft.model_def.family!r}"
+            )
+        if (draft.model_def.config["vocab_size"]
+                != loaded.model_def.config["vocab_size"]):
+            raise RuntimeError_(
+                "draft and target must share a vocabulary: "
+                f"{draft.model_def.config['vocab_size']} vs "
+                f"{loaded.model_def.config['vocab_size']}"
+            )
+        if spec_tokens < 1:
+            raise RuntimeError_(
+                f"spec_tokens must be >= 1, got {spec_tokens}"
+            )
+        d_st = self._build_slot_state(
+            draft, draft_id, state.slots, state.page_tokens, 0, 0,
+            state.arena_dtype, state.kernel,
+        )
+        # the build re-pointed the arena-bytes gauge at the draft; restore
+        # the target's value — the gauge documents the SERVING arena (the
+        # draft arena is spec overhead, visible via spec_* metrics instead)
+        self._note_arena_bytes(state)
+        # host mirrors alias the target's: both caches always sit at the
+        # same accepted positions, so one array serves both censuses
+        d_st.tok = state.tok
+        d_st.pos = state.pos
+        d_st.active = state.active
+        state.spec_draft_id = draft_id
+        state.spec_draft = d_st
+        state.spec_tokens = min(next_bucket(min(int(spec_tokens), 8)), 8)
+        return d_st
+
+    def slot_decode_spec_round(
+        self, state: SlotDecodeState
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One speculative draft/verify round for every active lane —
+        the spec counterpart of ``slot_decode_chunk``. Requires an attached
+        draft (``slot_attach_draft``). Returns (toks (S, spec+1), accept
+        (S,)): lane ``s`` emitted ``toks[s, :accept[s]]`` this round
+        (accept == 0 for frozen lanes). Raises ModelNotLoadedError naming
+        whichever half of the pair was evicted mid-decode — the engine
+        detaches the draft and falls back to plain chunks on the draft,
+        fails its rows on the target, exactly like ``slot_decode_chunk``."""
+        import jax
+
+        from tfservingcache_tpu.models.speculative import (
+            _paged_spec_round_jit,
+        )
+
+        d_st = state.spec_draft
+        if d_st is None:
+            raise RuntimeError_("no draft attached (slot_attach_draft)")
+        loaded = self._resident.get(state.model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {state.model_id} is not loaded")
+        d_loaded = self._resident.get(d_st.model_id)
+        if d_loaded is None:
+            raise ModelNotLoadedError(
+                f"draft model {d_st.model_id} is not loaded"
+            )
+        # the draft mirrors may have been rebound by admission writes on
+        # the target's arrays; re-alias before the census checks
+        d_st.tok, d_st.pos, d_st.active = state.tok, state.pos, state.active
+        state.chunk_counter += 1
+        rng = jax.random.PRNGKey(state.chunk_counter)
+        if _PAGECHECK:
+            _check_trash_unreachable(state)
+            _check_trash_unreachable(d_st)
+        (state.k, state.v, state.scales,
+         d_st.k, d_st.v, d_st.scales,
+         tok, pos, toks, accept) = _paged_spec_round_jit(
+            loaded.params, d_loaded.params,
+            state.k, state.v, state.scales,
+            d_st.k, d_st.v, d_st.scales,
+            np.asarray(state.block_tables, np.int32),
+            np.asarray(d_st.block_tables, np.int32),
+            state.tok, state.pos, state.active,
+            rng, state.temps, state.topks,
+            cfg_t_key=state.cfg_key, cfg_d_key=d_st.cfg_key,
+            family_t=state.family, family_d=d_st.family,
+            spec=state.spec_tokens, page_tokens=state.page_tokens,
+            kernel=state.kernel,
+        )
+        # np.array (not asarray): device_get hands back READ-ONLY views and
+        # the scheduler writes these mirrors at the next admission
+        state.tok = np.array(jax.device_get(tok), dtype=np.int32)
+        state.pos = np.array(jax.device_get(pos), dtype=np.int32)
+        d_st.tok, d_st.pos = state.tok, state.pos
+        return (np.asarray(jax.device_get(toks)),
+                np.array(jax.device_get(accept), dtype=np.int32))
+
     # -- unload / introspection --------------------------------------------
     def _on_evict(self, model_id: ModelId, entry: LRUEntry[LoadedModel]) -> None:
         self._set_state(model_id, ModelState.UNLOADING)
@@ -2273,6 +2401,14 @@ class TPUModelRuntime(BaseRuntime):
 
     def unload(self, model_id: ModelId) -> None:
         self._resident.remove(model_id, run_callback=True)
+        # _on_evict prunes _spec_health only when the model was RESIDENT;
+        # an unload of a non-resident id (already evicted, or gate state
+        # resurrected by a generate that finished after eviction) must
+        # still drop the pair entries, or tenant churn grows the dict
+        # forever (ISSUE 16 satellite — both roles of the pair)
+        with self._spec_lock:
+            for pair in [p for p in self._spec_health if model_id in p]:
+                del self._spec_health[pair]
 
     def is_loaded(self, model_id: ModelId) -> bool:
         return self._resident.get(model_id, touch=False) is not None
@@ -2401,14 +2537,28 @@ class TPUModelRuntime(BaseRuntime):
             return st["skipped"] % SPEC_REPROBE_EVERY == 0
 
     def _spec_observe(self, target: ModelId, draft: ModelId, emitted: int,
-                      rounds: int) -> None:
+                      rounds: int, engine: str = "solo") -> None:
         """Record one speculative generate's acceptance; flip the pair's
         disabled flag on a sustained low streak (VERDICT r5 #6 — the health
-        signal existed since round 4 but nothing acted on it)."""
+        signal existed since round 4 but nothing acted on it). ``engine``
+        labels the cumulative counters (solo generate vs continuous spec
+        rounds — the acceptance-rate trend per serving path)."""
         tpr = emitted / max(1, rounds)
         if self.metrics is not None:
-            self.metrics.spec_tokens_per_round.set(round(tpr, 3))
+            label = self.metrics.model_label(target.name, target.version)
+            self.metrics.spec_tokens_per_round.labels(model=label).set(
+                round(tpr, 3)
+            )
+            self.metrics.spec_accepted_tokens.labels(engine=engine).inc(
+                int(emitted)
+            )
+            self.metrics.spec_rounds.labels(engine=engine).inc(int(rounds))
         if not self._spec_gate_active:
+            return
+        if not (self.is_loaded(target) and self.is_loaded(draft)):
+            # either half unloaded mid-generate: recording would resurrect
+            # the pair entry unload() just pruned (the setdefault below),
+            # re-leaking gate state for a dead pair
             return
         with self._spec_lock:
             st = self._spec_health.setdefault(

@@ -181,6 +181,8 @@ class CacheNode:
                 kv_share_prefix_bytes=cfg.serving.kv_share_prefix_bytes,
                 kv_paged_kernel=cfg.serving.kv_paged_kernel,
                 kv_arena_dtype=cfg.serving.kv_arena_dtype,
+                spec_draft_model=cfg.serving.spec_draft_model,
+                spec_tokens=cfg.serving.spec_tokens,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first

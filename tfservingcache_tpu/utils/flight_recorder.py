@@ -73,6 +73,9 @@ STEP_FIELDS = (
     # so the positional indices older dumps/tools rely on stay valid
     "pages_shared",    # arena pages referenced by >1 owner after this step
     "prefix_hits",     # admissions this boundary that reused prefix KV
+    # appended fields (ISSUE 16 in-engine speculative decoding)
+    "drafted",         # draft tokens proposed this step (0 = plain chunk)
+    "accepted",        # tokens emitted by the verify round this step
 )
 
 DEFAULT_RING_ENTRIES = 4096
@@ -85,13 +88,14 @@ def _step_dict(e: tuple) -> dict[str, Any]:
     than dict(zip) — snapshot() materializes tail*models of these and is
     budgeted at < 5 ms for 128 tenant rings); short tuples (deserialized
     from pre-ISSUE-9 dumps) fall back to zip."""
-    if len(e) == 14:
+    if len(e) == 16:
         return {
             "t_wall": e[0], "engine": e[1], "step_ms": e[2], "chunk": e[3],
             "active": e[4], "admitted": e[5], "retired": e[6],
             "pages_used": e[7], "pages_free": e[8], "wasted": e[9],
             "queue_depth": e[10], "oldest_wait_ms": e[11],
             "pages_shared": e[12], "prefix_hits": e[13],
+            "drafted": e[14], "accepted": e[15],
         }
     return dict(zip(STEP_FIELDS, e))
 
@@ -220,11 +224,14 @@ class FlightRecorder:
         oldest_wait_ms: float = 0.0,
         pages_shared: int = 0,
         prefix_hits: int = 0,
+        drafted: int = 0,
+        accepted: int = 0,
     ) -> None:
         self._ring(model).append((
             time.time(), engine, round(step_ms, 4), chunk, active, admitted,
             retired, pages_used, pages_free, wasted, queue_depth,
             round(oldest_wait_ms, 3), pages_shared, prefix_hits,
+            drafted, accepted,
         ))
 
     def note_phases(
@@ -275,6 +282,7 @@ class FlightRecorder:
         # single pass (not one generator sweep per aggregate): this runs
         # per model per snapshot, so at 128 tenant rings the constant matters
         total = wasted = admitted = hits = 0
+        drafted = accepted = spec_slots = 0
         step_ms = 0.0
         max_depth = 0
         max_wait = 0.0
@@ -294,6 +302,12 @@ class FlightRecorder:
                 max_shared = e[12]
             if len(e) > 13:
                 hits += e[13]
+            if len(e) > 15 and e[14]:
+                # speculative steps only: acceptance = emitted tokens over
+                # the round's emission capacity (active * (spec+1) slots)
+                drafted += e[14]
+                accepted += e[15]
+                spec_slots += e[4] * e[3]
         return {
             "steps": len(entries),
             "step_slots": total,
@@ -306,6 +320,11 @@ class FlightRecorder:
             "prefix_hits": hits,
             "prefix_hit_rate": round(hits / admitted, 6) if admitted else 0.0,
             "max_pages_shared": max_shared,
+            "drafted": drafted,
+            "accepted": accepted,
+            "spec_acceptance": (
+                round(accepted / spec_slots, 6) if spec_slots else 0.0
+            ),
         }
 
     def engine_stats(self, tail: int = 32) -> dict[str, float]:
@@ -319,6 +338,8 @@ class FlightRecorder:
         wasted = 0
         depth = 0
         wait_ms = 0.0
+        spec_slots = 0
+        accepted = 0
         for ring in list(self._rings.values()):
             entries = ring.tail(tail)
             if not entries:
@@ -326,6 +347,9 @@ class FlightRecorder:
             for e in entries:
                 total += e[4] * e[3]                     # active * chunk
                 wasted += e[9]
+                if len(e) > 15 and e[14]:
+                    spec_slots += e[4] * e[3]
+                    accepted += e[15]
             last = entries[-1]
             depth += last[10]
             wait_ms = max(wait_ms, last[11])
@@ -333,6 +357,11 @@ class FlightRecorder:
             "goodput": (total - wasted) / total if total else 1.0,
             "queue_depth": depth,
             "oldest_wait_ms": wait_ms,
+            # emitted tokens over speculative emission capacity in the
+            # window; 0.0 when no spec round ran (spec off or disabled)
+            "spec_acceptance": (
+                accepted / spec_slots if spec_slots else 0.0
+            ),
         }
 
     def snapshot(

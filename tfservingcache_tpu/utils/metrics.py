@@ -404,11 +404,31 @@ class Metrics:
             "Draft models auto-disabled after sustained low acceptance",
             registry=r,
         )
+        # model label gated on metrics.model_labels (off = one all_models
+        # series, last-write-wins across pairs exactly as before; on = the
+        # TARGET model's acceptance is attributable per tenant)
         self.spec_tokens_per_round = Gauge(
             "tpusc_spec_tokens_per_round",
             "Most recent speculative acceptance (emitted tokens per verify "
-            "round; spec_tokens+1 = every proposal accepted)",
-            registry=r,
+            "round; spec_tokens+1 = every proposal accepted; labeled by "
+            "target model when model_labels is on, else one all_models "
+            "series)",
+            ["model"], registry=r,
+        )
+        # cumulative acceptance by engine (engine = solo | continuous):
+        # rate(accepted)/rate(rounds) is the fleet acceptance trend the
+        # last-write-wins gauge above cannot provide
+        self.spec_accepted_tokens = Counter(
+            "tpusc_spec_accepted_tokens",
+            "Tokens emitted by speculative verify rounds (accepted draft "
+            "prefix + the target's own correction token)",
+            ["engine"], registry=r,
+        )
+        self.spec_rounds = Counter(
+            "tpusc_spec_rounds",
+            "Speculative draft/verify rounds executed (per active lane "
+            "under the continuous engine)",
+            ["engine"], registry=r,
         )
         # per-tenant cost attribution (utils/accounting.py TenantLedger):
         # the ledger's monotonic integrals mirrored at scrape time via

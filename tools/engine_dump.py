@@ -132,6 +132,13 @@ def render(dump: dict, max_steps: int = 32, out=sys.stdout) -> None:
                 f"(rate={win.get('prefix_hit_rate', 0.0):.3f}), "
                 f"max shared pages={win.get('max_pages_shared', 0)}\n"
             )
+        if win.get("drafted"):
+            w(
+                f"speculation: {win.get('accepted', 0)} tokens emitted / "
+                f"{win['drafted']} drafted "
+                f"(acceptance={win.get('spec_acceptance', 0.0):.3f} of "
+                f"emission capacity)\n"
+            )
         spans = _stall_spans(steps)
         if spans:
             w("stall spans (steps with a non-empty admission queue):\n")
