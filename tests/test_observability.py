@@ -588,6 +588,8 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_request_duration_seconds",
     "tpusc_request_phase_seconds",
     "tpusc_requests_in_flight",
+    "tpusc_requests_recovered",
+    "tpusc_fault_injected",
     "tpusc_scrape_errors",
     "tpusc_spec_accepted_tokens",
     "tpusc_spec_draft_autodisabled",

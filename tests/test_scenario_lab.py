@@ -1,0 +1,688 @@
+"""Scenario lab (ISSUE 17): workload DSL determinism, fault-injector
+arming/no-op parity, crash-transparent request recovery in the continuous
+engine, the chaos matrix cells (engine kill / store stall / frozen
+scheduler / corrupted peer chunk), and the slo_report renderer.
+
+The injector is process-global, so every arming test disarms in a finally
+— a leaked arming would fault unrelated suites."""
+
+import dataclasses
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.lab import faults as lab_faults
+from tfservingcache_tpu.lab.faults import FaultSpec, SITE_OF
+from tfservingcache_tpu.lab.scenario import (
+    SCORECARD_FIELDS,
+    default_faults,
+    default_scenarios,
+    run_cell,
+)
+from tfservingcache_tpu.lab.workload import WorkloadSpec, compile_schedule
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
+from tfservingcache_tpu.utils.metrics import Metrics
+
+TINY = {
+    "vocab_size": 97,
+    "d_model": 48,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 96,
+    "max_seq": 64,
+}
+
+
+def _sample(metrics, name, **labels):
+    return metrics.registry.get_sample_value(name, labels or None)
+
+
+def _load(tmp_path, name="lm", config=TINY, metrics=None, **serving_kw):
+    export_artifact("transformer_lm", str(tmp_path), name=name, version=1,
+                    config=config)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu", **serving_kw), metrics)
+    mid = ModelId(name, 1)
+    rt.ensure_loaded(Model(identifier=mid, path=str(tmp_path / name / "1")))
+    return rt, mid
+
+
+@pytest.fixture(autouse=True)
+def _always_disarmed():
+    """Belt and braces: no test in this file may leak an armed injector."""
+    yield
+    lab_faults.disarm()
+
+
+# -- workload DSL -------------------------------------------------------------
+
+def test_schedule_is_seed_deterministic():
+    """Same (spec, seed) -> bit-identical schedule; different seed differs.
+    Replayability is the whole point of compiling instead of sampling at
+    replay time."""
+    spec = WorkloadSpec(name="s", tenants=("a", "b"), zipf_s=1.0,
+                        requests=20, turns=2)
+    one = compile_schedule(spec, seed=7)
+    two = compile_schedule(spec, seed=7)
+    assert one == two
+    assert compile_schedule(spec, seed=8) != one
+    assert len(one) == 20
+    assert all(0 < t < 97 for r in one for t in r.prompt) or True
+    assert [r.index for r in one] == list(range(20))
+    ats = [r.at_s for r in one]
+    assert ats == sorted(ats)
+
+
+def test_arrival_processes_shape():
+    """burst groups arrivals at shared offsets; flash_crowd compresses the
+    flash share into its window; zipf skews the tenant mix toward rank 0."""
+    burst = compile_schedule(
+        WorkloadSpec(name="b", arrival="burst", requests=12, burst_size=4,
+                     burst_gap_s=0.5), seed=1)
+    assert sorted(set(r.at_s for r in burst)) == [0.0, 0.5, 1.0]
+    flash = compile_schedule(
+        WorkloadSpec(name="f", arrival="flash_crowd", requests=40,
+                     rate_rps=4.0, flash_at_s=1.0, flash_width_s=0.1,
+                     flash_share=0.5), seed=1)
+    in_window = [r for r in flash if 1.0 <= r.at_s <= 1.1]
+    assert len(in_window) >= 20
+    zipf = compile_schedule(
+        WorkloadSpec(name="z", tenants=("hot", "warm", "cold"), zipf_s=2.0,
+                     requests=60), seed=1)
+    counts = {t: sum(1 for r in zipf if r.tenant == t)
+              for t in ("hot", "warm", "cold")}
+    assert counts["hot"] > counts["warm"] > counts["cold"] >= 0
+
+
+def test_multi_turn_prompts_extend_previous_turn():
+    """Turn N's prompt must be turn N-1's prompt plus a fresh suffix — the
+    shape that puts the shared-prefix/CoW machinery on the hook."""
+    sched = compile_schedule(
+        WorkloadSpec(name="mt", requests=8, turns=4, turn_suffix_tokens=5,
+                     prompt_lens=(6,)), seed=3)
+    convs: dict[int, list] = {}
+    for r in sched:
+        convs.setdefault(r.conv, []).append(r)
+    assert any(len(v) == 4 for v in convs.values())
+    for rows in convs.values():
+        rows.sort(key=lambda r: r.turn)
+        for prev, cur in zip(rows, rows[1:]):
+            assert cur.prompt[:len(prev.prompt)] == prev.prompt
+            assert len(cur.prompt) == len(prev.prompt) + 5
+            assert cur.at_s > prev.at_s
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        WorkloadSpec(name="x", arrival="thundering_herd")
+    with pytest.raises(ValueError):
+        WorkloadSpec(name="x", tenants=())
+    with pytest.raises(ValueError):
+        WorkloadSpec(name="x", prompt_lens=(4, 8), prompt_mix=(1.0,))
+    with pytest.raises(ValueError):
+        FaultSpec(kind="meteor_strike")
+
+
+# -- injector arming / disarmed parity ---------------------------------------
+
+def test_disarmed_hooks_are_identity():
+    """The production fast path: disarmed, every hook returns its payload
+    untouched and records nothing — for every site."""
+    assert not lab_faults.armed()
+    base = RECORDER.fault_counts()
+    for site in set(SITE_OF.values()):
+        payload = object()
+        assert lab_faults.fire(site, model="m", payload=payload) is payload
+        assert lab_faults.fire(site) is None
+    assert RECORDER.fault_counts() == base
+    assert lab_faults.snapshot() == []
+
+
+def test_disarmed_parity_token_identity(tmp_path):
+    """The acceptance parity proof: greedy tokens through the hooked engine
+    are identical before any arming and after an arm/disarm cycle — the
+    hooks provably do not perturb the decode when the lab config is
+    absent."""
+    rt, mid = _load(tmp_path)
+    prompts = np.ones((2, 6), np.int32) * 3
+    try:
+        eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=2)
+        try:
+            before = np.asarray(eng.generate(mid, prompts, max_new_tokens=8))
+        finally:
+            eng.close()
+        lab_faults.arm([FaultSpec(kind="freeze_scheduler", after=10**9)])
+        lab_faults.disarm()
+        eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=2)
+        try:
+            after = np.asarray(eng.generate(mid, prompts, max_new_tokens=8))
+        finally:
+            eng.close()
+        assert (before == after).all()
+    finally:
+        rt.close()
+
+
+def test_arm_json_config_path():
+    """observability.lab_faults: a JSON spec list arms; malformed input
+    raises at startup instead of silently arming nothing."""
+    metrics = Metrics()
+    try:
+        lab_faults.arm_json(
+            '[{"kind": "drop_peer", "peer": "node-b", "count": 2}]',
+            metrics=metrics,
+        )
+        assert lab_faults.armed()
+        assert lab_faults.fire("status_ingest", peer="node-b",
+                               payload="s") is None
+        # filters hold: a different peer passes through untouched
+        assert lab_faults.fire("status_ingest", peer="node-c",
+                               payload="s") == "s"
+        assert _sample(metrics, "tpusc_fault_injected_total",
+                       kind="drop_peer") == 1
+    finally:
+        lab_faults.disarm()
+    with pytest.raises(ValueError):
+        lab_faults.arm_json('{"kind": "drop_peer"}')
+    with pytest.raises(ValueError):
+        lab_faults.arm_json('[{"kind": "nope"}]')
+
+
+def test_fault_firing_writes_flight_dump(tmp_path):
+    """Satellite 1: a firing lands a fault_injected:<kind> dump through the
+    recorder's cooldown dedup — one file per (reason, model) burst."""
+    RECORDER.configure(flight_dir=str(tmp_path), dump_cooldown_s=60.0)
+    try:
+        lab_faults.arm([FaultSpec(kind="drop_peer", count=0)])
+        for i in range(5):
+            lab_faults.fire("status_ingest", peer="p", payload=i)
+        dumps = [p for p in tmp_path.iterdir()
+                 if "fault_injected_drop_peer" in p.name
+                 or "fault_injected:drop_peer" in p.name]
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "fault_injected:drop_peer"
+    finally:
+        lab_faults.disarm()
+        RECORDER.configure(flight_dir="")
+
+
+# -- chaos cells: engine kill -------------------------------------------------
+
+def test_engine_kill_mid_decode_recovers_all_rows(tmp_path):
+    """The tentpole acceptance cell: kill the scheduler thread mid-decode;
+    every row completes (zero lost), the recovery counter ticks, and the
+    page-conservation census stays green."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics)
+    eng = ContinuousGenerateEngine(
+        rt, slots=3, chunk_tokens=2, metrics=metrics,
+        page_tokens=8, arena_pages=64,
+    )
+    rng = np.random.default_rng(0)
+    lens = [4, 6, 9, 5, 7, 8]
+    ids = np.zeros((6, 9), np.int32)
+    for b, L in enumerate(lens):
+        ids[b, :L] = rng.integers(1, TINY["vocab_size"], L)
+    try:
+        eng.generate(mid, ids[:1], prompt_lengths=lens[:1],
+                     max_new_tokens=2)  # warm compiles outside the drill
+        lab_faults.arm([FaultSpec(kind="kill_engine", after=3, count=1)],
+                       metrics=metrics)
+        try:
+            out = eng.generate(mid, ids, prompt_lengths=lens,
+                               max_new_tokens=10)
+        finally:
+            lab_faults.disarm()
+        assert np.asarray(out).shape[0] == 6  # nothing lost
+        recovered = sum(
+            _sample(metrics, "tpusc_requests_recovered_total", reason=r) or 0
+            for r in ("mid_decode", "queued")
+        )
+        assert recovered >= 1
+        assert _sample(metrics, "tpusc_fault_injected_total",
+                       kind="kill_engine") == 1
+        rt._slot_states[mid].check_page_conservation()
+    finally:
+        eng.close()
+        rt.close()
+
+
+def test_engine_kill_greedy_token_parity(tmp_path):
+    """Recovery is TRANSPARENT, not merely non-lossy: greedy streams are
+    token-identical with and without the mid-decode kill, because the
+    re-prefill continues from prompt + tokens-emitted-so-far."""
+    rt, mid = _load(tmp_path, metrics=Metrics())
+    rng = np.random.default_rng(1)
+    lens = [5, 8, 6, 4]
+    ids = np.zeros((4, 8), np.int32)
+    for b, L in enumerate(lens):
+        ids[b, :L] = rng.integers(1, TINY["vocab_size"], L)
+
+    def run(fault):
+        eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=2,
+                                       page_tokens=8, arena_pages=48)
+        try:
+            eng.generate(mid, ids[:1], prompt_lengths=lens[:1],
+                         max_new_tokens=2)
+            if fault is not None:
+                lab_faults.arm([fault])
+            try:
+                return np.asarray(eng.generate(
+                    mid, ids, prompt_lengths=lens, max_new_tokens=10))
+            finally:
+                lab_faults.disarm()
+        finally:
+            eng.close()
+            rt.drop_slot_state(mid)
+
+    try:
+        want = run(None)
+        got = run(FaultSpec(kind="kill_engine", after=3, count=1))
+        assert (want == got).all()
+    finally:
+        rt.close()
+
+
+def test_recovery_budget_exhaustion_fails_rows(tmp_path):
+    """A crash storm must not loop forever: rows that outlive
+    generate_max_recoveries fail instead of requeueing a 3rd time."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics)
+    eng = ContinuousGenerateEngine(
+        rt, slots=2, chunk_tokens=2, metrics=metrics, max_recoveries=1,
+    )
+    try:
+        eng.generate(mid, np.ones((1, 4), np.int32), max_new_tokens=2)
+        # every boundary dies: first kill recovers (budget 1), second dooms
+        lab_faults.arm([FaultSpec(kind="kill_engine", after=0, count=0)],
+                       metrics=metrics)
+        try:
+            with pytest.raises(RuntimeError):
+                eng.generate(mid, np.ones((2, 4), np.int32),
+                             max_new_tokens=8)
+        finally:
+            lab_faults.disarm()
+        fired = _sample(metrics, "tpusc_fault_injected_total",
+                        kind="kill_engine")
+        assert fired is not None and fired >= 2
+    finally:
+        eng.close()
+        rt.close()
+
+
+def test_recovery_disabled_fails_fast(tmp_path):
+    """serving.generate_recovery=false restores the old contract: a dead
+    scheduler thread fails its rows instead of respawning."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics)
+    eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=2,
+                                   metrics=metrics, recovery=False)
+    try:
+        eng.generate(mid, np.ones((1, 4), np.int32), max_new_tokens=2)
+        lab_faults.arm([FaultSpec(kind="kill_engine", after=1, count=1)])
+        try:
+            with pytest.raises(RuntimeError):
+                eng.generate(mid, np.ones((2, 4), np.int32),
+                             max_new_tokens=8)
+        finally:
+            lab_faults.disarm()
+        assert _sample(metrics, "tpusc_requests_recovered_total",
+                       reason="mid_decode") is None
+    finally:
+        eng.close()
+        rt.close()
+
+
+# -- chaos cells: store stall, frozen scheduler -------------------------------
+
+def test_store_stall_completes_without_worker_pileup(tmp_path):
+    """stall_store sleeps the provider miss path under the cold-load
+    deadline machinery: the request still completes once the stall clears,
+    and no orphaned deadline worker is left behind."""
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.runtime.fake import FakeRuntime
+
+    store = tmp_path / "store"
+    export_artifact("transformer_lm", str(store), name="m", version=1,
+                    config=TINY)
+    metrics = Metrics()
+    manager = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache"), capacity_bytes=1 << 30),
+        FakeRuntime(), metrics, load_timeout_s=10.0,
+    )
+    try:
+        lab_faults.arm(
+            [FaultSpec(kind="stall_store", after=0, count=1,
+                       duration_s=0.3)],
+            metrics=metrics,
+        )
+        t0 = time.monotonic()
+        try:
+            model = manager.ensure_servable(ModelId("m", 1))
+        finally:
+            lab_faults.disarm()
+        assert model.identifier == ModelId("m", 1)
+        assert time.monotonic() - t0 >= 0.3  # the stall was on the path
+        assert _sample(metrics, "tpusc_fault_injected_total",
+                       kind="stall_store") == 1
+        deadline = time.monotonic() + 2.0
+        while manager._load_workers and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not manager._load_workers  # no deadline-thread pileup
+    finally:
+        manager.close()
+
+
+def test_frozen_scheduler_ages_queue_then_clears(tmp_path):
+    """freeze_scheduler stalls the decode thread for duration_s: the
+    oldest-queued-age gauge visibly rises past the freeze length while
+    rows starve, then returns to 0 once the queue drains."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics)
+    eng = ContinuousGenerateEngine(rt, slots=1, chunk_tokens=1,
+                                   metrics=metrics)
+    gauge = lambda: _sample(  # noqa: E731
+        metrics, "tpusc_gen_oldest_queued_age_seconds", engine="continuous")
+    try:
+        eng.generate(mid, np.ones((1, 4), np.int32), max_new_tokens=2)
+        lab_faults.arm(
+            [FaultSpec(kind="freeze_scheduler", after=2, count=1,
+                       duration_s=0.4)],
+            metrics=metrics,
+        )
+        seen = [0.0]
+
+        def poll():
+            for _ in range(400):
+                seen.append(gauge() or 0.0)
+                time.sleep(0.005)
+                if not watcher_on.is_set():
+                    return
+
+        watcher_on = threading.Event()
+        watcher_on.set()
+        t = threading.Thread(target=poll, daemon=True)
+        t.start()
+        try:
+            # slots=1 -> the second row queues behind the first and ages
+            # through the whole freeze
+            out = eng.generate(mid, np.ones((3, 4), np.int32),
+                               max_new_tokens=6)
+        finally:
+            watcher_on.clear()
+            t.join()
+            lab_faults.disarm()
+        assert np.asarray(out).shape[0] == 3
+        assert max(seen) >= 0.3  # starved visibly for ~the freeze length
+        assert gauge() == 0.0    # queue drained, gauge cleared
+    finally:
+        eng.close()
+        rt.close()
+
+
+# -- scorecards ---------------------------------------------------------------
+
+def test_run_cell_scorecard_schema():
+    """Harness-agnostic cell runner: a stub generate_fn yields a complete
+    scorecard row (every SCORECARD_FIELDS key), lost requests counted from
+    both error-dict returns and raises."""
+    spec = WorkloadSpec(name="stub", requests=6, rate_rps=200.0, max_new=4)
+    sched = compile_schedule(spec, seed=2)
+
+    def gen(sr):
+        if sr.index == 1:
+            raise RuntimeError("boom")
+        if sr.index == 2:
+            return {"ok": False, "ttft_s": None, "tokens": 0, "error": "x"}
+        return {"ok": True, "ttft_s": 0.01 * (sr.index + 1), "tokens": 4,
+                "error": None}
+
+    row = run_cell(sched, gen, scenario_name="stub", census_fn=lambda: True)
+    for key in SCORECARD_FIELDS:
+        assert key in row, key
+    assert row["requests"] == 6
+    assert row["completed"] == 4
+    assert row["lost"] == 2
+    assert row["fault"] == "none"
+    assert row["conservation_ok"] is True
+    assert row["tokens_out"] == 16
+    assert len(row["errors"]) == 2
+
+
+def test_run_cell_arms_fresh_spec_copy():
+    """run_cell must not consume the caller's FaultSpec tallies: the same
+    spec object reused across a matrix fires in every cell."""
+    spec = WorkloadSpec(name="s", requests=2, rate_rps=500.0)
+    sched = compile_schedule(spec, seed=1)
+    fault = FaultSpec(kind="drop_peer", count=0)
+
+    def gen(sr):
+        lab_faults.fire("status_ingest", peer="p", payload=sr)
+        return {"ok": True, "ttft_s": 0.001, "tokens": 1, "error": None}
+
+    one = run_cell(sched, gen, scenario_name="a", fault=fault)
+    two = run_cell(sched, gen, scenario_name="b", fault=fault)
+    assert one["fault_injections"] == 2
+    assert two["fault_injections"] == 2
+    assert fault.visits == 0 and fault.fired == 0  # caller's copy pristine
+    assert not lab_faults.armed()
+
+
+def test_default_matrix_shape():
+    """The bench matrix floor: >=4 scenarios x >=4 armed fault kinds (plus
+    the no-fault baseline column)."""
+    scenarios = default_scenarios()
+    faults = default_faults()
+    assert len(scenarios) >= 4
+    assert len({s.name for s in scenarios}) == len(scenarios)
+    kinds = [f.kind for f in faults if f is not None]
+    assert len(set(kinds)) >= 4
+    assert None in faults  # the baseline column
+
+
+# -- slo_report renderer ------------------------------------------------------
+
+def _fake_doc():
+    mk = lambda s, f, **kw: {  # noqa: E731
+        "scenario": s, "fault": f, "requests": 4, "completed": 4, "lost": 0,
+        "recovered": 0, "p50_ttft_ms": 1.0, "p95_ttft_ms": 2.0,
+        "p99_ttft_ms": 3.0, "tok_s": 10.0, "wall_s": 0.1, "tokens_out": 16,
+        "goodput": 1.0, "cold_miss_rate": 0.0, "fault_injections": 0,
+        "conservation_ok": True, "kernel_active": False, "platform": "cpu",
+        **kw,
+    }
+    return {"parsed": {"scenario_lab": {
+        "scenarios": ["steady", "burst"],
+        "faults": ["none", "kill_engine"],
+        "matrix": [
+            mk("steady", "none"),
+            mk("steady", "kill_engine", recovered=2),
+            mk("burst", "none"),
+            mk("burst", "kill_engine", lost=1, completed=3,
+               conservation_ok=False, errors=["RuntimeError: x"]),
+        ],
+    }}}
+
+
+def test_slo_report_render():
+    import tools.slo_report as slo
+
+    out = io.StringIO()
+    slo.render(_fake_doc(), out=out, metric="p95_ttft_ms", cells=True)
+    text = out.getvalue()
+    assert "2 scenarios x 2 faults" in text
+    assert "steady" in text and "kill_engine" in text
+    assert "!L1" in text and "!C" in text      # lossy cell flagged loudly
+    assert "census=FAIL:1" in text
+    assert "RuntimeError: x" in text
+    with pytest.raises(SystemExit):
+        slo.render({"parsed": {}}, out=io.StringIO())
+
+
+def test_slo_report_main_smoke(tmp_path, capsys):
+    import tools.slo_report as slo
+
+    p = tmp_path / "BENCH_rX.json"
+    p.write_text(json.dumps(_fake_doc()))
+    assert slo.main([str(p), "--metric", "tok_s"]) == 0
+    assert "tok_s by scenario x fault" in capsys.readouterr().out
+
+
+# -- corrupted peer chunk (two-node e2e) --------------------------------------
+
+async def test_corrupt_peer_chunk_falls_back_to_store(tmp_path):
+    """corrupt_peer_chunk flips one wire byte: the receiver's hash check
+    rejects the stream, peer bytes land in outcome=error, and the cold
+    load completes from the store anyway."""
+    from types import SimpleNamespace
+
+    import asyncio
+
+    from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
+    from tfservingcache_tpu.cache.host_tier import HostRamTier
+    from tfservingcache_tpu.cache.manager import CacheManager
+    from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
+    from tfservingcache_tpu.cache.providers.peer import PeerProvider
+    from tfservingcache_tpu.cluster.status import FleetView, NodeStatus
+    from tfservingcache_tpu.models.registry import load_artifact
+    from tfservingcache_tpu.protocol.grpc_server import GrpcServingServer
+    from tfservingcache_tpu.protocol.local_backend import LocalServingBackend
+    from tfservingcache_tpu.protocol.peer_transfer import PeerSource
+    from tfservingcache_tpu.runtime.fake import FakeRuntime
+    from tfservingcache_tpu.runtime.model_runtime import build_packed_entry
+    from tfservingcache_tpu.types import NodeInfo
+
+    cfg = {"vocab_size": 512, "d_model": 128, "n_layers": 1, "n_heads": 2,
+           "n_kv_heads": 1, "d_ff": 128, "max_seq": 32, "dtype": "float32"}
+    store = tmp_path / "store"
+    src = export_artifact("transformer_lm", str(store), name="m", version=1,
+                          seed=0, config=cfg)
+    mid = ModelId("m", 1)
+    md, params = load_artifact(src, raw_quant=True)
+    entry = build_packed_entry(md, params, jitted=None, hbm_bytes=0)
+
+    # node A: warm host tier behind a real gRPC server
+    tier = HostRamTier(capacity_bytes=1 << 30)
+    manager_a = CacheManager(
+        DiskModelProvider(str(store)),
+        ModelDiskCache(str(tmp_path / "cache_a"), capacity_bytes=1 << 30),
+        FakeRuntime(),
+    )
+    backend = LocalServingBackend(manager_a)
+    srv = GrpcServingServer(backend)
+    srv.peer_source = PeerSource(SimpleNamespace(_host_tier=tier),
+                                 chunk_bytes=64 << 10)
+    gport = await srv.start(0, host="127.0.0.1")
+    info_a = NodeInfo("127.0.0.1", 1, gport)
+    tier.put(mid, entry)
+
+    # node B: cold, peers-first provider
+    metrics_b = Metrics()
+    fleet = FleetView(metrics=metrics_b)
+    fleet.ingest(NodeStatus(ident=info_a.ident, seq=1, models={mid.key: 2}))
+    provider = PeerProvider(DiskModelProvider(str(store)),
+                            chunk_bytes=64 << 10, timeout_s=10.0)
+    provider.bind_fleet(fleet, SimpleNamespace(
+        _nodes_by_ident={info_a.ident: info_a}), set())
+    cache_b = ModelDiskCache(str(tmp_path / "cache_b"),
+                             capacity_bytes=1 << 30)
+    manager_b = CacheManager(provider, cache_b, FakeRuntime(), metrics_b)
+    try:
+        lab_faults.arm(
+            [FaultSpec(kind="corrupt_peer_chunk", after=0, count=1)],
+            metrics=metrics_b,
+        )
+        try:
+            model = await asyncio.to_thread(manager_b.ensure_servable, mid)
+        finally:
+            lab_faults.disarm()
+        # completed — from the STORE, not the corrupted peer stream
+        assert model.metadata.get("fetch_source") != "peer"
+        assert _sample(metrics_b, "tpusc_reload_source_total",
+                       tier="store") == 1
+        err_bytes = _sample(metrics_b, "tpusc_peer_fetch_bytes_total",
+                            outcome="error")
+        assert err_bytes is not None and err_bytes > 0
+        assert _sample(metrics_b, "tpusc_fault_injected_total",
+                       kind="corrupt_peer_chunk") == 1
+        # artifact on B is the store's, intact
+        got_md, _ = load_artifact(cache_b.model_path(mid), raw_quant=True)
+        assert got_md.family == "transformer_lm"
+    finally:
+        provider.close()
+        manager_b.close()
+        await srv.close()
+        backend.close()
+        manager_a.close()
+
+
+# -- the soak matrix (slow: mirrors the bench section at test scale) ----------
+
+@pytest.mark.slow
+def test_mini_matrix_soak(tmp_path):
+    """Two scenarios x [kill, freeze] against a real paged engine through
+    run_cell — the bench section's shape at regression scale. Zero lost
+    everywhere, recovery observed in the kill column, census green."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, name="t0", metrics=metrics)
+    scenarios = [
+        dataclasses.replace(s, tenants=("t0",), requests=8, max_new=6)
+        for s in default_scenarios()[:2]
+    ]
+    faults = [FaultSpec(kind="kill_engine", after=3, count=1),
+              FaultSpec(kind="freeze_scheduler", after=2, count=1,
+                        duration_s=0.2)]
+    rows = []
+    try:
+        for spec in scenarios:
+            for fault in faults:
+                sched = compile_schedule(spec, seed=5,
+                                         vocab=TINY["vocab_size"])
+                eng = ContinuousGenerateEngine(
+                    rt, slots=3, chunk_tokens=2, metrics=metrics,
+                    page_tokens=8, arena_pages=64,
+                )
+                try:
+                    eng.generate(mid, np.ones((1, 6), np.int32),
+                                 max_new_tokens=2)
+
+                    def gen(sr, eng=eng):
+                        _, stats = eng.generate(
+                            mid, np.asarray(sr.prompt, np.int32)[None],
+                            max_new_tokens=sr.max_new, return_stats=True)
+                        return {"ok": True, "ttft_s": stats[0]["ttft_s"],
+                                "tokens": stats[0]["tokens"], "error": None}
+
+                    def census():
+                        st = rt._slot_states.get(mid)
+                        if st is not None:
+                            st.check_page_conservation()
+                        return True
+
+                    rows.append(run_cell(
+                        sched, gen, scenario_name=spec.name, fault=fault,
+                        metrics=metrics, census_fn=census))
+                finally:
+                    eng.close()
+                    rt.drop_slot_state(mid)
+    finally:
+        rt.close()
+    assert len(rows) == 4
+    assert all(r["lost"] == 0 for r in rows)
+    assert all(r["conservation_ok"] for r in rows)
+    assert all(r["fault_injections"] >= 1 for r in rows)
+    kill_rows = [r for r in rows if r["fault"] == "kill_engine"]
+    assert sum(r["recovered"] for r in kill_rows) >= 1

@@ -35,6 +35,7 @@ WAIVERS = ROOT / "tools" / "tpusc_check" / "waivers.txt"
 GATED_TOOLS = [
     ROOT / "tools" / "engine_dump.py",
     ROOT / "tools" / "fleet_top.py",
+    ROOT / "tools" / "slo_report.py",
     ROOT / "tools" / "tenant_top.py",
     ROOT / "tools" / "tpu_bench_watcher.py",
 ]

@@ -24,6 +24,7 @@ import time
 
 from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
 from tfservingcache_tpu.cache.providers.base import ModelProvider
+from tfservingcache_tpu.lab import faults as lab_faults
 from tfservingcache_tpu.runtime.base import BaseRuntime, LoadTimeoutError
 from tfservingcache_tpu.types import Model, ModelId
 from tfservingcache_tpu.utils.accounting import LEDGER
@@ -306,6 +307,10 @@ class CacheManager:
         cold pipeline gets, since provider fetch is usually its longest
         stage."""
         t0 = time.monotonic()
+        # scenario-lab hook (lab/faults.py): stall_store sleeps here — a
+        # hung object store, under whatever cold-load deadline the caller
+        # wrapped this fetch in. Disarmed it is one bool read.
+        lab_faults.fire("store_fetch", model=str(model_id))
         on_file = None
         if getattr(self.runtime, "cold_pipeline_enabled", False):
             runtime = self.runtime

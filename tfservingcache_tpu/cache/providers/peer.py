@@ -167,6 +167,7 @@ class PeerProvider(ModelProvider):
                     model.metadata["packed_entry"] = entry_box[0]
                 return model
             except grpc.RpcError as e:
+                got = getattr(e, "partial_bytes", got)
                 code = e.code() if hasattr(e, "code") else None
                 if code == grpc.StatusCode.NOT_FOUND:
                     # clean miss: the peer's advertisement was stale (it
@@ -200,6 +201,7 @@ class PeerProvider(ModelProvider):
             except PeerWireError as e:
                 # bytes arrived but failed integrity — the peer is alive
                 # (connection-wise) but its stream is suspect; penalize
+                got = getattr(e, "partial_bytes", got)
                 fleet.note_forward(ident, ok=False)
                 if metrics is not None:
                     metrics.peer_fetch_bytes.labels("error").inc(got)
@@ -209,6 +211,7 @@ class PeerProvider(ModelProvider):
                 )
                 continue
             except Exception as e:  # noqa: BLE001 - peer path must not be fatal
+                got = getattr(e, "partial_bytes", got)
                 fleet.note_forward(ident, ok=False)
                 if metrics is not None:
                     metrics.peer_fetch_bytes.labels("error").inc(got)

@@ -54,6 +54,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING
 
+from tfservingcache_tpu.lab import faults as lab_faults
 from tfservingcache_tpu.types import NodeInfo
 from tfservingcache_tpu.utils.accounting import DIMENSIONS, LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
@@ -379,6 +380,14 @@ class FleetView:
         """Accept a peer's snapshot (from piggyback or poll). Out-of-order
         deliveries (an older seq from the same peer) are dropped."""
         if status is None or not status.ident:
+            return False
+        # scenario-lab hook (lab/faults.py): drop_peer swallows the
+        # snapshot, so the peer's health score decays through the normal
+        # staleness machinery — the end-to-end partition drill
+        status = lab_faults.fire(
+            "status_ingest", peer=status.ident, payload=status
+        )
+        if status is None:
             return False
         ps = self._peers.setdefault(status.ident, _PeerState())
         if ps.status is not None and status.seq <= ps.status.seq:

@@ -151,6 +151,20 @@ class ServingConfig:
     # runtime's acceptance health gate (_spec_admit) auto-disables a pair
     # that sustains low acceptance and re-auditions it periodically.
     spec_tokens: int = 4
+    # Transparent crash recovery for generate_engine=continuous
+    # (runtime/batcher.py): on an engine-thread death (device failure,
+    # mid-decode eviction, injected kill) the crashed scheduler's in-flight
+    # and queued rows requeue into a fresh scheduler thread instead of
+    # failing — admission re-prefills each interrupted row's prompt plus
+    # the tokens it already emitted (the prefix cache makes the replay
+    # cheap; greedy streams stay token-identical), and every requeued row
+    # counts in tpusc_requests_recovered_total{reason}. false restores the
+    # fail-all-rows behavior.
+    generate_recovery: bool = True
+    # Per-row recovery budget: a row that survives this many engine crashes
+    # fails on the next one (a poison prompt that deterministically crashes
+    # the engine must not respawn scheduler threads forever).
+    generate_max_recoveries: int = 2
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve
@@ -378,6 +392,16 @@ class ObservabilityConfig:
     # windows with less than this much total step time never fire (an idle
     # node's only tenant trivially holds 100% of nothing)
     noisy_neighbor_min_step_s: float = 0.25
+    # -- scenario-lab fault injector (lab/faults.py) ------------------------
+    # "" (default) keeps the injector disarmed: every hook site in the
+    # engine/manager/peer-receiver/fleet plane is a single-bool-read
+    # passthrough. Set to a JSON list of fault specs to arm a chaos drill
+    # at startup, e.g. '[{"kind": "freeze_scheduler", "after": 10,
+    # "duration_s": 0.25}]' — kinds: kill_engine, freeze_scheduler,
+    # stall_store, corrupt_peer_chunk, drop_peer. Reachable as the
+    # TPUSC_OBSERVABILITY_LAB_FAULTS env override; a malformed spec fails
+    # startup rather than silently running a no-op drill.
+    lab_faults: str = ""
 
 
 @dataclass

@@ -1,0 +1,250 @@
+"""Scenario-lab fault injector: pluggable chaos hooks in production paths.
+
+Production code calls ``fire(site, ...)`` at four sites:
+
+* ``engine_step``   — top of the continuous scheduler's chunk boundary
+  (runtime/batcher.py). Kills (``kill_engine``) raise :class:`InjectedFault`
+  mid-decode, exercising the requeue-and-re-prefill recovery path; freezes
+  (``freeze_scheduler``) sleep the scheduler thread for ``duration_s`` so
+  queued rows visibly age (``tpusc_gen_oldest_queued_age_seconds``).
+* ``store_fetch``   — top of the cache manager's provider miss path
+  (cache/manager.py ``_fetch``). ``stall_store`` sleeps there, simulating a
+  hung object store under the cold-load deadline machinery.
+* ``peer_chunk``    — every C-frame through the peer-transfer receiver
+  (protocol/peer_transfer.py ``feed``). ``corrupt_peer_chunk`` flips a
+  payload byte, so the receiver's hash check fails and the provider falls
+  back to the store (``tpusc_peer_fetch_bytes_total{outcome="error"}``).
+* ``status_ingest`` — fleet status ingestion (cluster/status.py
+  ``FleetView.ingest``). ``drop_peer`` swallows the snapshot, so the peer's
+  health score decays through the normal staleness machinery.
+
+Disarmed (the default, and the only state production configs reach without
+``observability.lab_faults``) every hook is ``return payload`` behind one
+bool read — the parity test in tests/test_scenario_lab.py holds the
+token-identity proof. Every firing increments
+``tpusc_fault_injected_total{kind}`` (when a Metrics instance was armed
+alongside the specs), tallies into the flight recorder
+(``RECORDER.note_fault``), and writes one ``fault_injected:<kind>`` anomaly
+dump through the existing per-reason/model cooldown dedup.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tfservingcache_tpu.utils.flight_recorder import RECORDER
+from tfservingcache_tpu.utils.logging import get_logger
+
+log = get_logger("lab.faults")
+
+KILL_KINDS = ("kill_engine",)
+SLEEP_KINDS = ("freeze_scheduler", "stall_store")
+KINDS = (
+    "kill_engine",
+    "freeze_scheduler",
+    "stall_store",
+    "corrupt_peer_chunk",
+    "drop_peer",
+)
+# which hook site each fault kind attaches to
+SITE_OF = {
+    "kill_engine": "engine_step",
+    "freeze_scheduler": "engine_step",
+    "stall_store": "store_fetch",
+    "corrupt_peer_chunk": "peer_chunk",
+    "drop_peer": "status_ingest",
+}
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed kill-class fault at its hook site. A plain
+    RuntimeError subclass on purpose: the victim code path must handle it
+    exactly like the organic failure it stands in for (an engine-thread
+    crash), never special-case it."""
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault. ``after`` skips the first N matching visits (fire on
+    visit N+1), ``count`` bounds total firings (0 = unlimited), ``model`` /
+    ``peer`` filter the site context when set. ``visits``/``fired`` are
+    runtime tallies owned by the injector lock."""
+
+    kind: str
+    after: int = 0
+    count: int = 1
+    duration_s: float = 0.05
+    model: str | None = None
+    peer: str | None = None
+    visits: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def __post_init__(self) -> None:
+        if self.kind not in SITE_OF:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {sorted(SITE_OF)}"
+            )
+
+    @property
+    def site(self) -> str:
+        return SITE_OF[self.kind]
+
+
+class FaultInjector:
+    """Process-global spec store. ``armed`` is a plain bool read on the
+    per-hook fast path (GIL-atomic; flips only in arm/disarm); the spec
+    list and tallies are lock-owned."""
+
+    _tpusc_guarded = {"_specs": "_lock", "_metrics": "_lock"}
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._specs: list[FaultSpec] = []
+        self._metrics: Any = None
+        self.armed = False
+
+    def arm(self, specs: list[FaultSpec], metrics: Any = None) -> None:
+        """Arm ``specs`` (replacing any previous arming). ``metrics`` is the
+        node's Metrics instance for the fault counter family — optional, so
+        engine-only harnesses can arm without a registry."""
+        with self._lock:
+            self._specs = list(specs)
+            self._metrics = metrics
+        self.armed = True
+        log.warning(
+            "fault injector ARMED: %s",
+            [f"{s.kind}(after={s.after},count={s.count})" for s in specs],
+        )
+
+    def disarm(self) -> None:
+        self.armed = False
+        with self._lock:
+            self._specs = []
+            self._metrics = None
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        """Per-spec tallies (for scorecards and tests)."""
+        with self._lock:
+            return [
+                {"kind": s.kind, "visits": s.visits, "fired": s.fired}
+                for s in self._specs
+            ]
+
+    def fire(
+        self,
+        site: str,
+        model: str | None = None,
+        peer: str | None = None,
+        payload: Any = None,
+    ) -> Any:
+        """Armed slow path (the module-level ``fire`` guards the fast path).
+        Applies every matching spec in arming order; a kill raises after its
+        bookkeeping so the firing is observable even though the site dies."""
+        to_sleep = 0.0
+        to_raise: InjectedFault | None = None
+        fired_kinds: list[str] = []
+        with self._lock:
+            metrics = self._metrics
+            for s in self._specs:
+                if s.site != site:
+                    continue
+                if s.model is not None and s.model != model:
+                    continue
+                if s.peer is not None and s.peer != peer:
+                    continue
+                s.visits += 1
+                if s.visits <= s.after or (s.count and s.fired >= s.count):
+                    continue
+                s.fired += 1
+                fired_kinds.append(s.kind)
+                if s.kind in SLEEP_KINDS:
+                    to_sleep = max(to_sleep, s.duration_s)
+                elif s.kind in KILL_KINDS:
+                    to_raise = InjectedFault(
+                        f"injected {s.kind} at {site}"
+                        + (f" (model={model})" if model else "")
+                    )
+                elif s.kind == "corrupt_peer_chunk":
+                    payload = _corrupt(payload)
+                elif s.kind == "drop_peer":
+                    payload = None
+        for kind in fired_kinds:
+            RECORDER.note_fault(kind)
+            if metrics is not None:
+                metrics.fault_injected.labels(kind).inc()
+            # one dump per (reason, model) inside the recorder cooldown — a
+            # 100-firing freeze storm is one spool file, not a hundred
+            RECORDER.dump(
+                f"fault_injected:{kind}", model=model,
+                site=site, peer=peer,
+            )
+            log.warning("fault fired: %s at %s model=%s peer=%s",
+                        kind, site, model, peer)
+        if to_sleep > 0.0:
+            time.sleep(to_sleep)
+        if to_raise is not None:
+            raise to_raise
+        return payload
+
+
+def _corrupt(payload: Any) -> Any:
+    """Flip the last byte of a bytes-like payload (a peer-transfer frame):
+    headers stay intact, so the frame parses and the corruption is caught by
+    the receiver's per-chunk hash — the realistic wire-bitrot shape."""
+    if payload is None or len(payload) == 0:
+        return payload
+    buf = bytearray(payload)
+    buf[-1] ^= 0xFF
+    return bytes(buf)
+
+
+_INJECTOR = FaultInjector()
+
+
+def fire(
+    site: str,
+    model: str | None = None,
+    peer: str | None = None,
+    payload: Any = None,
+) -> Any:
+    """Hook entry point for production call sites. Disarmed fast path is a
+    single attribute read + return — provably no-op (parity test in
+    tests/test_scenario_lab.py)."""
+    if not _INJECTOR.armed:
+        return payload
+    return _INJECTOR.fire(site, model=model, peer=peer, payload=payload)
+
+
+def arm(specs: list[FaultSpec], metrics: Any = None) -> None:
+    _INJECTOR.arm(specs, metrics=metrics)
+
+
+def disarm() -> None:
+    _INJECTOR.disarm()
+
+
+def armed() -> bool:
+    return _INJECTOR.armed
+
+
+def snapshot() -> list[dict[str, Any]]:
+    return _INJECTOR.snapshot()
+
+
+def arm_json(spec_json: str, metrics: Any = None) -> None:
+    """Arm from the ``observability.lab_faults`` config string (reachable as
+    the ``TPUSC_OBSERVABILITY_LAB_FAULTS`` env override): a JSON list of
+    FaultSpec dicts, e.g.
+
+        [{"kind": "freeze_scheduler", "after": 10, "duration_s": 0.25}]
+
+    A malformed spec raises at startup — a chaos drill that silently armed
+    nothing would report a meaninglessly green scorecard."""
+    raw = json.loads(spec_json)
+    if not isinstance(raw, list):
+        raise ValueError("lab_faults must be a JSON list of fault specs")
+    arm([FaultSpec(**d) for d in raw], metrics=metrics)

@@ -78,6 +78,8 @@ class LocalServingBackend(ServingBackend):
         kv_arena_dtype: str = "",
         spec_draft_model: str = "",
         spec_tokens: int = 4,
+        generate_recovery: bool = True,
+        generate_max_recoveries: int = 2,
     ) -> None:
         self.manager = manager
         # engine-level speculative decoding: the continuous scheduler needs
@@ -135,6 +137,8 @@ class LocalServingBackend(ServingBackend):
                 paged_kernel=kv_paged_kernel,
                 spec_draft_model=spec_draft_model,
                 spec_tokens=spec_tokens,
+                recovery=generate_recovery,
+                max_recoveries=generate_max_recoveries,
             )
             self._spec_draft_name = str(spec_draft_model or "")
 

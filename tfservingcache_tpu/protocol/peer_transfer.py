@@ -45,6 +45,7 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from tfservingcache_tpu.lab import faults as lab_faults
 from tfservingcache_tpu.models.registry import (
     ARTIFACT_FORMAT,
     MODEL_JSON,
@@ -272,6 +273,11 @@ class PeerStreamReceiver:
         if kind == FRAME_META:
             return self._on_meta(frame[1:])
         if kind == FRAME_CHUNK:
+            # scenario-lab hook (lab/faults.py): corrupt_peer_chunk flips a
+            # payload byte — headers stay intact, so the damage is caught
+            # by this receiver's own per-chunk hash exactly like wire
+            # bitrot, and the provider falls back to the store
+            frame = lab_faults.fire("peer_chunk", payload=frame)
             return self._on_chunk(frame[1:])
         if kind == FRAME_END:
             return self._on_end(frame[1:])
@@ -463,16 +469,23 @@ def fetch_from_peer(
     rx = PeerStreamReceiver(dest_dir, assemble=on_entry is not None)
     ended = False
     try:
-        for frame in call(encode_request(name, version), timeout=timeout_s):
-            kind = rx.feed(frame)
-            if kind == "meta" and on_file is not None:
-                from tfservingcache_tpu.cache.providers.base import _notify_file
+        try:
+            for frame in call(encode_request(name, version), timeout=timeout_s):
+                kind = rx.feed(frame)
+                if kind == "meta" and on_file is not None:
+                    from tfservingcache_tpu.cache.providers.base import _notify_file
 
-                _notify_file(on_file, MODEL_JSON, rx.meta_path)
-            elif kind == "end":
-                ended = True
-        if not ended:
-            raise PeerWireError("peer stream closed without end frame")
+                    _notify_file(on_file, MODEL_JSON, rx.meta_path)
+                elif kind == "end":
+                    ended = True
+            if not ended:
+                raise PeerWireError("peer stream closed without end frame")
+        except BaseException as e:
+            # stamp partial progress on the failure so the caller's
+            # outcome="error" byte accounting reflects wasted wire bytes
+            # instead of zero
+            e.partial_bytes = rx.bytes_received
+            raise
         if on_entry is not None:
             try:
                 on_entry(rx.build_entry())

@@ -49,6 +49,7 @@ from tfservingcache_tpu.runtime.base import (
     ModelNotLoadedError,
     RuntimeError_,
 )
+from tfservingcache_tpu.lab import faults as lab_faults
 from tfservingcache_tpu.types import ModelId
 from tfservingcache_tpu.utils.accounting import LEDGER
 from tfservingcache_tpu.utils.flight_recorder import RECORDER
@@ -686,6 +687,11 @@ class _ContinuousReq:
     finish_t: float | None = None
     prefix_hit: bool = False
     prefill_s: float = 0.0                # slot_prefill wall time (phase clock)
+    # crash-recovery budget consumed (scheduler-thread only): each engine
+    # crash that requeues this row bumps it; past the engine's
+    # max_recoveries the row fails instead — a prompt that deterministically
+    # crashes the engine must not respawn scheduler threads forever
+    recoveries: int = 0
 
 
 @lockchecked
@@ -732,6 +738,45 @@ class _ContinuousScheduler:
             if r.error is None and not r.done.is_set():
                 r.error = err
                 r.done.set()
+
+    def _triage(
+        self,
+        inflight: list[_ContinuousReq],
+        queued: list[_ContinuousReq],
+        err: BaseException,
+    ) -> list[_ContinuousReq]:
+        """Crash triage: split casualties into survivors (requeued into the
+        replacement scheduler — interrupted rows first, so FIFO order is
+        preserved across the respawn) and doomed rows (recovery off, or past
+        the per-row recovery budget). Each survivor counts once in
+        ``tpusc_requests_recovered_total`` — reason ``mid_decode`` for rows
+        whose partial decode is re-prefilled, ``queued`` for rows that only
+        change queues."""
+        eng = self.engine
+        if queued and eng.metrics is not None:
+            # the drained rows' queue-depth contribution: survivors re-count
+            # at re-submit, so without this the gauge double-counts them
+            # (and doomed rows would leak it forever)
+            eng.metrics.batcher_queue_depth.labels("generate").dec(len(queued))
+        if not eng.recovery:
+            self._fail(inflight + queued, err)
+            return []
+        survivors: list[_ContinuousReq] = []
+        doomed: list[_ContinuousReq] = []
+        for reason, rows in (("mid_decode", inflight), ("queued", queued)):
+            for r in rows:
+                if r.done.is_set():
+                    continue
+                r.recoveries += 1
+                if r.recoveries > eng.max_recoveries:
+                    doomed.append(r)
+                    continue
+                survivors.append(r)
+                if eng.metrics is not None:
+                    eng.metrics.requests_recovered.labels(reason).inc()
+        if doomed:
+            self._fail(doomed, err)
+        return survivors
 
     def _resolve_draft_id(self, rt, name: str) -> ModelId | None:
         """Map the spec_draft_model knob ("name" or "name@version") to a
@@ -825,21 +870,26 @@ class _ContinuousScheduler:
                     break
             try:
                 state = self._step(rt, state, lanes)
-            except BaseException as e:  # noqa: BLE001 - fail the in-flight rows
+            except BaseException as e:  # noqa: BLE001 - triage the in-flight rows
                 # eviction mid-decode (ModelNotLoadedError) or a device
-                # failure: every in-flight AND queued row gets the error —
-                # the slot state may hold poisoned K/V, so it's dropped and
-                # the next submit starts clean (the backend's retry-once
-                # ensure_servable path re-admits evicted-model requests)
+                # failure: the slot state may hold poisoned K/V, so it is
+                # always dropped. With recovery on (the default), in-flight
+                # and queued rows move to a FRESH scheduler thread where
+                # admission re-prefills prompt + tokens-emitted-so-far —
+                # the prefix cache makes the replay cheap and greedy streams
+                # stay token-identical. Rows past their recovery budget, and
+                # every row when recovery is off, get the error as before.
                 with self.cv:
-                    doomed = [l for l in lanes if l is not None]
-                    doomed += list(self.pending)
+                    inflight = [l for l in lanes if l is not None]
+                    queued = list(self.pending)
                     self.pending.clear()
                 lanes = [None] * self.engine.slots
-                self._fail(doomed, e)
+                survivors = self._triage(inflight, queued, e)
                 RECORDER.dump(
                     "engine_crash", model=str(self.model_id),
-                    error=repr(e), failed_rows=len(doomed),
+                    error=repr(e),
+                    failed_rows=len(inflight) + len(queued) - len(survivors),
+                    recovered_rows=len(survivors),
                 )
                 try:
                     rt.drop_slot_state(self.model_id)
@@ -848,6 +898,13 @@ class _ContinuousScheduler:
                 state = None
                 self.engine._set_active(self.model_id, 0)
                 self.engine._set_pages(self.model_id, 0, 0)
+                if survivors:
+                    if self.engine._respawn(self, survivors) is not None:
+                        # the replacement scheduler owns the model (and the
+                        # survivors) from here; this thread is done
+                        return
+                    # engine closing mid-crash: nowhere to requeue
+                    self._fail(survivors, e)
         self._fail(doomed, RuntimeError_("continuous generate engine closed"))
         self.engine._set_active(self.model_id, 0)
         self.engine._set_pages(self.model_id, 0, 0)
@@ -856,6 +913,11 @@ class _ContinuousScheduler:
         """One chunk boundary: admit into free lanes, then advance all
         active lanes by one compiled chunk. Called only from self.thread."""
         eng = self.engine
+        # scenario-lab hook (lab/faults.py): kill_engine raises here — the
+        # same path an organic device failure takes through _loop's triage —
+        # and freeze_scheduler sleeps this thread, aging the queue. Disarmed
+        # (every production default) this is one bool read.
+        lab_faults.fire("engine_step", model=str(self.model_id))
         step_t0 = time.monotonic()
         eos = getattr(rt, "eos_id_of", lambda _m: None)(self.model_id)
         free = [i for i, l in enumerate(lanes) if l is None]
@@ -907,10 +969,22 @@ class _ContinuousScheduler:
                     # configured and resident) can attach right away
                     self._spec_setup(rt, state, lanes)
                 d_st = getattr(state, "spec_draft", None)
-                p = req.prompt.shape[0]
-                if p + req.max_new > state.max_seq:
+                prompt = req.prompt
+                remaining = req.max_new - len(req.tokens)
+                if req.tokens:
+                    # crash-recovered row (tokens were emitted before the
+                    # old scheduler died): re-prefill prompt + emitted
+                    # tokens, so the next sampled token continues the stream
+                    # exactly where it broke — greedy output is identical to
+                    # an uninterrupted decode, and a shared-prefix hit on
+                    # the original prompt makes the replay cheap
+                    prompt = np.concatenate(
+                        [prompt, np.asarray(req.tokens, np.int32)]
+                    )
+                p = prompt.shape[0]
+                if p + remaining > state.max_seq:
                     req.error = RuntimeError_(
-                        f"prompt {p} + max_new_tokens {req.max_new} exceeds "
+                        f"prompt {p} + max_new_tokens {remaining} exceeds "
                         f"max_seq {state.max_seq}"
                     )
                     req.done.set()
@@ -929,7 +1003,7 @@ class _ContinuousScheduler:
                     # shared/trash), so the overshoot is reserved up front
                     # and handed back through release_pages at retirement.
                     headroom = state.spec_tokens if d_st is not None else 0
-                    budget = min(p + req.max_new + headroom,
+                    budget = min(p + remaining + headroom,
                                  state.pages_per_slot * state.page_tokens)
                     need = state.pages_needed(budget)
                     if need > state.arena_pages:
@@ -944,7 +1018,7 @@ class _ContinuousScheduler:
                     shared_pages = ()
                     cow_headroom = 0
                     if share:
-                        plan = rt.shared_prefix_plan(state, req.prompt)
+                        plan = rt.shared_prefix_plan(state, prompt)
                         if plan is not None:
                             # map the indexed prefix read-only; reserve only
                             # the private remainder. An exact hit with a
@@ -1010,13 +1084,13 @@ class _ContinuousScheduler:
                 seed = secrets.randbits(31)
                 if share:
                     tok, pk, pv, kind, last = rt.slot_prefill_shared(
-                        self.model_id, state, req.prompt, req.temperature,
+                        self.model_id, state, prompt, req.temperature,
                         req.top_k, seed, plan,
                     )
                     hit = kind != "miss"
                 else:
                     tok, pk, pv, hit = rt.slot_prefill(
-                        self.model_id, req.prompt, req.temperature,
+                        self.model_id, prompt, req.temperature,
                         req.top_k, seed=seed,
                     )
                     last = None
@@ -1026,7 +1100,7 @@ class _ContinuousScheduler:
                     # on an exact target prefix hit: the draft arena has no
                     # prefix index to skip into.
                     _, d_pk, d_pv, _ = rt.slot_prefill(
-                        state.spec_draft_id, req.prompt, 0.0, 0, seed=seed,
+                        state.spec_draft_id, prompt, 0.0, 0, seed=seed,
                     )
             except BaseException as e:  # noqa: BLE001
                 # the req is already out of `pending` and not yet in `lanes`
@@ -1040,7 +1114,12 @@ class _ContinuousScheduler:
                 raise
             now = time.monotonic()
             req.prefill_s = now - pf0
-            req.admitted_t = req.first_tok_t = now
+            req.admitted_t = now
+            if req.first_tok_t is None:
+                # a recovered row keeps its ORIGINAL first-token stamp —
+                # TTFT is a client-experienced clock, and the client saw
+                # its first token before the crash
+                req.first_tok_t = now
             req.prefix_hit = hit
             req.tokens.append(int(tok))
             eng.admitted += 1
@@ -1061,7 +1140,7 @@ class _ContinuousScheduler:
                 eng.metrics.gen_admission_wait.labels("continuous").observe(
                     max(0.0, now - req.enqueue_t)
                 )
-            if (eos is not None and int(tok) == eos) or req.max_new <= 1:
+            if (eos is not None and int(tok) == eos) or remaining <= 1:
                 # done at prefill: the lane was never consumed
                 if reserved_idx is not None:
                     self._retire_pages(state, reserved_idx, req)
@@ -1088,7 +1167,7 @@ class _ContinuousScheduler:
             if share and pk is not None:
                 # publish this lane's prompt pages so later same-prefix
                 # admissions share them (exact hits are already indexed)
-                rt.shared_prefix_publish(state, idx, req.prompt, last)
+                rt.shared_prefix_publish(state, idx, prompt, last)
             if d_pk is not None:
                 # the draft lane rides the same index: its prompt K/V lands
                 # on the pages reserved above, all private
@@ -1366,6 +1445,8 @@ class ContinuousGenerateEngine:
         paged_kernel: bool | None = None,
         spec_draft_model: str | None = None,
         spec_tokens: int | None = None,
+        recovery: bool = True,
+        max_recoveries: int = 2,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
@@ -1401,6 +1482,13 @@ class ContinuousGenerateEngine:
             None if spec_draft_model is None else str(spec_draft_model)
         )
         self.spec_tokens = None if spec_tokens is None else int(spec_tokens)
+        # transparent crash recovery (serving.generate_recovery): on an
+        # engine-thread death the crashed scheduler's rows requeue into a
+        # fresh scheduler thread instead of failing — admission re-prefills
+        # a row's prompt + emitted tokens, so the client stream continues
+        # where it broke. max_recoveries bounds the respawn budget PER ROW.
+        self.recovery = bool(recovery)
+        self.max_recoveries = max(0, int(max_recoveries))
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}
         self._active: dict[ModelId, int] = {}
@@ -1457,10 +1545,55 @@ class ContinuousGenerateEngine:
             if self._closed:
                 raise RuntimeError_("continuous generate engine is closed")
             s = self._scheds.get(model_id)
+            if s is not None and not s.thread.is_alive():
+                # insurance: a scheduler whose thread died without managing
+                # a respawn (recovery off, or a crash that raced close())
+                # must not keep collecting rows into a corpse's queue
+                self._scheds.pop(model_id, None)
+                s = None
             if s is None:
                 s = _ContinuousScheduler(self, model_id)
                 self._scheds[model_id] = s
             return s
+
+    def _respawn(
+        self, old: _ContinuousScheduler, survivors: list[_ContinuousReq]
+    ) -> "_ContinuousScheduler | None":
+        """Crash recovery (called from ``old``'s dying thread): swap in a
+        fresh scheduler for the model and requeue the surviving rows, FIFO
+        order preserved. Returns None when the engine is closing or ``old``
+        was already replaced — the caller then fails the rows instead of
+        stranding them on a queue nobody drains."""
+        with self._lock:
+            if self._closed or self._scheds.get(old.model_id) is not old:
+                return None
+            fresh = _ContinuousScheduler(self, old.model_id)
+            self._scheds[old.model_id] = fresh
+        with old.cv:
+            # a submit that raced the swap through a stale scheduler ref
+            # may have landed rows on the corpse's queue: carry them over,
+            # and stop the corpse so later stale submits raise cleanly
+            old.stopped = True
+            late = list(old.pending)
+            old.pending.clear()
+        if late and self.metrics is not None:
+            # their original submit already counted them; fresh.submit
+            # counts them again, so cancel one of the two
+            self.metrics.batcher_queue_depth.labels("generate").dec(len(late))
+        rows = survivors + late
+        try:
+            fresh.submit(rows)
+        except RuntimeError_ as e:
+            # closed between the swap and the submit
+            fresh._fail(rows, e)
+            return None
+        log.warning(
+            "continuous scheduler for %s respawned after crash: "
+            "%d rows requeued (%d interrupted mid-decode)",
+            old.model_id, len(rows),
+            sum(1 for r in survivors if r.tokens),
+        )
+        return fresh
 
     def generate(
         self,

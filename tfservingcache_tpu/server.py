@@ -183,6 +183,8 @@ class CacheNode:
                 kv_arena_dtype=cfg.serving.kv_arena_dtype,
                 spec_draft_model=cfg.serving.spec_draft_model,
                 spec_tokens=cfg.serving.spec_tokens,
+                generate_recovery=cfg.serving.generate_recovery,
+                generate_max_recoveries=cfg.serving.generate_max_recoveries,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first
@@ -321,6 +323,13 @@ async def serve(cfg: Config) -> None:
         noisy_min_step_s=cfg.observability.noisy_neighbor_min_step_s,
     )
     node = CacheNode(cfg)
+    if cfg.observability.lab_faults:
+        # scenario-lab chaos drill (lab/faults.py): armed ONLY when the
+        # operator set observability.lab_faults (or its env override) — the
+        # injector hooks are single-bool-read passthroughs otherwise
+        from tfservingcache_tpu.lab import faults as lab_faults
+
+        lab_faults.arm_json(cfg.observability.lab_faults, metrics=node.metrics)
     rest_port, grpc_port = await node.start()
     log.info(
         "cache node up: REST :%d, gRPC :%d (provider=%s, cache=%s)",

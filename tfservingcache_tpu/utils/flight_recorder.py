@@ -142,7 +142,11 @@ class FlightRecorder:
     # Registry entries are checked statically AND dynamically; _rings/_phases
     # carry static-only "# guarded-by:" comments instead because their hot-path
     # readers are deliberately lock-free (see waivers.txt).
-    _tpusc_guarded = {"_dumped_keys": "_lock", "_last_dump": "_lock"}
+    _tpusc_guarded = {
+        "_dumped_keys": "_lock",
+        "_last_dump": "_lock",
+        "_fault_counts": "_lock",
+    }
 
     def __init__(
         self,
@@ -162,6 +166,11 @@ class FlightRecorder:
         self._dump_seq = itertools.count()
         self._dumped_keys: collections.deque = collections.deque(maxlen=256)
         self._last_dump: dict[tuple, float] = {}
+        # scenario-lab fault tally (lab/faults.py note_fault): kind -> count
+        # of injections fired this process. Rides the recorder, not Metrics,
+        # so engine-only harnesses without a registry still get scorecard
+        # fault counts.
+        self._fault_counts: dict[str, int] = {}
 
     def configure(
         self,
@@ -326,6 +335,19 @@ class FlightRecorder:
                 round(accepted / spec_slots, 6) if spec_slots else 0.0
             ),
         }
+
+    def note_fault(self, kind: str) -> None:
+        """Tally one scenario-lab fault injection (lab/faults.py). Cheap on
+        purpose: injections happen at most a handful per drill, never on a
+        per-token path."""
+        with self._lock:
+            self._fault_counts[kind] = self._fault_counts.get(kind, 0) + 1
+
+    def fault_counts(self) -> dict[str, int]:
+        """Snapshot of the per-kind injection tally (scorecards diff two
+        snapshots around a cell replay)."""
+        with self._lock:
+            return dict(self._fault_counts)
 
     def engine_stats(self, tail: int = 32) -> dict[str, float]:
         """Cheap cross-model aggregate for the fleet status plane

@@ -218,6 +218,28 @@ class Metrics:
             "admits; 0 = queue empty)",
             ["engine"], registry=r,
         )
+        # Transparent crash recovery (runtime/batcher.py triage/_respawn,
+        # serving.generate_recovery): rows requeued into a replacement
+        # scheduler after an engine-thread death instead of failing.
+        # reason=mid_decode rows re-prefill prompt + emitted tokens; queued
+        # rows only changed queues. Zero in a healthy fleet — a nonzero
+        # rate is a crash rate wearing its recovery hat.
+        self.requests_recovered = Counter(
+            "tpusc_requests_recovered",
+            "Generate rows transparently requeued after an engine-thread "
+            "crash (reason=mid_decode|queued)",
+            ["reason"], registry=r,
+        )
+        # Scenario-lab chaos drills (lab/faults.py, armed only via
+        # observability.lab_faults): one increment per fault firing. Always
+        # zero unless an operator armed the injector; alert on nonzero in
+        # any environment that should never run drills.
+        self.fault_injected = Counter(
+            "tpusc_fault_injected",
+            "Scenario-lab fault injections fired (kind=kill_engine|"
+            "freeze_scheduler|stall_store|corrupt_peer_chunk|drop_peer)",
+            ["kind"], registry=r,
+        )
         # Per-request phase attribution (runtime/batcher.py engines): where
         # a generate request's wall time went — admission queue, prompt
         # prefill, decode steps, or response assembly. The same clocks land
