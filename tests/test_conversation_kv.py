@@ -1,0 +1,446 @@
+"""Conversation-grade KV lifecycle (ISSUE 18): parked-blob codec
+round-trips, host->disk LRU spill + read-back promotion, zero-budget
+identity (tier off == today's engine byte-for-byte), multi-turn resume
+token identity (greedy engine-level AND seeded runtime-level), the
+parked-page census extension, peer-migration wire round-trips, and
+crash recovery re-prefilling through a parked ancestor."""
+
+import numpy as np
+import pytest
+
+from tfservingcache_tpu.cache.conversation_kv import (
+    KV_BLOB_MAGIC,
+    ConversationKVTier,
+    ParkedConversation,
+    pack_parked,
+    unpack_parked,
+)
+from tfservingcache_tpu.config import ServingConfig
+from tfservingcache_tpu.lab import faults as lab_faults
+from tfservingcache_tpu.lab.faults import FaultSpec
+from tfservingcache_tpu.models.registry import export_artifact
+from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
+from tfservingcache_tpu.runtime.model_runtime import TPUModelRuntime
+from tfservingcache_tpu.types import Model, ModelId
+from tfservingcache_tpu.utils.metrics import Metrics
+
+TINY = {
+    "vocab_size": 97,
+    "d_model": 48,
+    "n_layers": 2,
+    "n_heads": 4,
+    "n_kv_heads": 2,
+    "d_ff": 96,
+    "max_seq": 64,
+}
+PT = 8  # page size dividing max_seq (same rationale as test_paged_kv)
+
+
+def _load(tmp_path, name="lm", config=TINY, metrics=None, **serving_kw):
+    export_artifact("transformer_lm", str(tmp_path), name=name, version=1,
+                    config=config)
+    rt = TPUModelRuntime(ServingConfig(platform="cpu", **serving_kw), metrics)
+    mid = ModelId(name, 1)
+    rt.ensure_loaded(Model(identifier=mid, path=str(tmp_path / name / "1")))
+    return rt, mid
+
+
+def _mk_parked(model="lm@1", n_pages=3, hist_len=17, seed=0, scales=False):
+    rng = np.random.default_rng(seed)
+    layers, n_kv, hd = 2, 2, 12
+    shape = (layers, n_pages, n_kv, PT, hd)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    ks = vs = None
+    if scales:
+        k = (k * 16).astype(np.int8)
+        v = (v * 16).astype(np.int8)
+        ks = rng.standard_normal(shape[:4]).astype(np.float32)
+        vs = rng.standard_normal(shape[:4]).astype(np.float32)
+    hist = rng.integers(1, TINY["vocab_size"], hist_len).astype(np.int32)
+    return ParkedConversation(
+        model_id=model, history=hist, pages_k=k, pages_v=v,
+        k_scale=ks, v_scale=vs, page_tokens=PT,
+    )
+
+
+def _same_parked(a: ParkedConversation, b: ParkedConversation) -> None:
+    assert a.model_id == b.model_id
+    assert a.page_tokens == b.page_tokens
+    for name in ("history", "pages_k", "pages_v", "k_scale", "v_scale"):
+        x, y = getattr(a, name), getattr(b, name)
+        if x is None:
+            assert y is None
+            continue
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert x.tobytes() == y.tobytes()  # BYTE-exact, not allclose
+
+
+# -- blob codec ---------------------------------------------------------------
+
+@pytest.mark.parametrize("scales", [False, True])
+def test_pack_unpack_roundtrip_byte_exact(scales):
+    """The spill/wire blob must reproduce every array bit-for-bit — resume
+    correctness is defined as 'the lane's KV is byte-identical to one that
+    never retired', and the codec is the only lossy candidate in between."""
+    parked = _mk_parked(scales=scales)
+    blob = pack_parked(parked)
+    assert blob.startswith(KV_BLOB_MAGIC)
+    back = unpack_parked(blob)
+    _same_parked(parked, back)
+    assert back.nbytes == parked.nbytes
+
+
+def test_unpack_rejects_corruption():
+    blob = pack_parked(_mk_parked())
+    with pytest.raises(ValueError):
+        unpack_parked(b"NOPE!\n" + blob[len(KV_BLOB_MAGIC):])
+    with pytest.raises(ValueError):
+        unpack_parked(blob[:-3])          # truncated payload
+    with pytest.raises(ValueError):
+        unpack_parked(blob + b"\x00\x00")  # trailing junk
+
+
+# -- tier: LRU spill + promotion ---------------------------------------------
+
+def test_tier_spills_coldest_to_disk_and_repromotes(tmp_path):
+    """Host budget for ~1 conversation, disk behind it: parking a second
+    conversation spills the coldest to disk as a blob file; a later lookup
+    reads it back ('spilled' outcome) and re-promotes it host-ward."""
+    one = _mk_parked(hist_len=17, seed=1)
+    metrics = Metrics()
+    tier = ConversationKVTier(
+        capacity_bytes=int(one.nbytes * 1.5),
+        disk_capacity_bytes=64 << 20,
+        disk_dir=str(tmp_path / "kv"),
+        metrics=metrics,
+    )
+    try:
+        tier.put("alpha", one)
+        tier.put("beta", _mk_parked(hist_len=18, seed=2))
+        s = tier.stats()
+        assert s["host_conversations"] == 1 and s["disk_conversations"] == 1
+        assert s["spills"] == 1
+        spilled_files = list((tmp_path / "kv").glob("*.kv"))
+        assert len(spilled_files) == 1
+
+        got, outcome = tier.get("alpha", "lm@1")
+        assert outcome == "spilled"
+        _same_parked(one, got)
+        # re-promotion moved alpha host-ward (and pushed beta out to disk:
+        # the host budget still only holds one)
+        s = tier.stats()
+        assert s["host_conversations"] == 1 and s["disk_conversations"] == 1
+        assert s["spilled_hits"] == 1
+
+        # PEEK semantics: the entry stays parked after a hit
+        again, outcome = tier.get("alpha", "lm@1")
+        assert outcome == "hit"
+        _same_parked(one, again)
+
+        # unknown conversation and wrong model are both clean misses
+        assert tier.get("alpha", "other@1") == (None, "miss")
+        assert tier.get("gamma", "lm@1") == (None, "miss")
+        assert tier.stats()["misses"] == 2
+    finally:
+        tier.close()
+    assert not (tmp_path / "kv").exists()  # close() cleans the spill dir
+
+
+def test_tier_drop_model_and_oversized_park(tmp_path):
+    small = _mk_parked(hist_len=9, n_pages=2)
+    tier = ConversationKVTier(
+        capacity_bytes=small.nbytes + 1,
+        disk_capacity_bytes=1 << 20,
+        disk_dir=str(tmp_path / "kv"),
+    )
+    try:
+        # a single conversation larger than the whole budget is dropped
+        # (warn), never a crash and never a partial park
+        tier.put("huge", _mk_parked(n_pages=64))
+        assert len(tier) == 0
+        tier.put("c1", small)
+        tier.put("c2", _mk_parked(hist_len=9, n_pages=2, seed=7))
+        assert len(tier) == 2  # one host, one spilled
+        tier.drop_model("lm@1")
+        assert len(tier) == 0
+        assert tier.get("c1", "lm@1") == (None, "miss")
+    finally:
+        tier.close()
+
+
+def test_tier_zero_budget_is_inert(tmp_path):
+    tier = ConversationKVTier(capacity_bytes=0)
+    try:
+        assert not tier.enabled
+        tier.put("a", _mk_parked())
+        assert tier.get("a", "lm@1") == (None, "miss")
+        assert tier.parked_page_count() == 0
+        assert tier.stats()["enabled"] is False
+    finally:
+        tier.close()
+
+
+def test_census_counts_parked_pages_host_tier_only(tmp_path):
+    """parked_page_count feeds the conservation census: host entries count
+    their block-table pages, disk blobs are opaque (already off-arena
+    twice over) and are excluded by design."""
+    a = _mk_parked(hist_len=17, n_pages=3, seed=3)
+    tier = ConversationKVTier(
+        capacity_bytes=int(a.nbytes * 1.5),
+        disk_capacity_bytes=1 << 20,
+        disk_dir=str(tmp_path / "kv"),
+    )
+    try:
+        tier.put("a", a)
+        assert tier.parked_page_count() == 3
+        assert tier.parked_page_count("lm@1") == 3
+        assert tier.parked_page_count("other@1") == 0
+        tier.put("b", _mk_parked(hist_len=17, n_pages=3, seed=4))  # spills a
+        assert tier.parked_page_count() == 3
+        assert tier.stats()["disk_conversations"] == 1
+    finally:
+        tier.close()
+
+
+# -- engine: zero-budget identity --------------------------------------------
+
+def test_engine_zero_budget_identity(tmp_path):
+    """conversation_kv_bytes=0 (the default) must be byte-for-byte today's
+    engine: no tier object, conversation_id accepted but inert, outputs
+    identical to a request that never mentioned a conversation."""
+    rt, mid = _load(tmp_path, kv_page_tokens=PT, kv_arena_pages=32)
+    eng = ContinuousGenerateEngine(rt, slots=2, chunk_tokens=2)
+    try:
+        assert eng.conversation_tier is None
+        prompt = np.array([[5, 17, 40, 3, 9, 61, 2]], np.int32)
+        plain = eng.generate(mid, prompt, max_new_tokens=6)
+        tagged, stats = eng.generate(mid, prompt, max_new_tokens=6,
+                                     conversation_id="conv", return_stats=True)
+        assert (plain == tagged).all()
+        assert stats[0]["prefill_tokens"] == prompt.shape[1]
+        rt._slot_states[mid].check_page_conservation()
+    finally:
+        eng.close()
+        rt.close()
+
+
+# -- engine: multi-turn resume ------------------------------------------------
+
+def test_engine_park_resume_greedy_identity(tmp_path):
+    """The tentpole contract: turn 2 of a conversation resumes from parked
+    pages with an O(new tokens) suffix prefill, and emits EXACTLY the
+    tokens a cold full-prompt admission emits."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics, kv_page_tokens=PT,
+                    kv_arena_pages=48)
+    eng = ContinuousGenerateEngine(
+        rt, slots=2, chunk_tokens=2, metrics=metrics,
+        conversation_kv_bytes=32 << 20,
+    )
+    rng = np.random.default_rng(0)
+    p1 = rng.integers(1, TINY["vocab_size"], 12).astype(np.int32)
+    try:
+        out1, stats1 = eng.generate(mid, p1[None, :], max_new_tokens=6,
+                                    conversation_id="conv", return_stats=True)
+        assert stats1[0]["prefill_tokens"] == 12  # turn 1 is cold
+        assert eng.conversation_tier.stats()["parked_total"] == 1
+        assert eng.conversation_tier.parked_page_count(str(mid)) > 0
+
+        # turn 2 prompt: full visible conversation + a new user message
+        extra = rng.integers(1, TINY["vocab_size"], 4).astype(np.int32)
+        p2 = np.concatenate([p1, out1[0].astype(np.int32), extra])
+
+        # cold reference for the SAME prompt, fresh conversation (parks
+        # under its own id — never aliases conv's parked state)
+        ref = eng.generate(mid, p2[None, :], max_new_tokens=6,
+                           conversation_id="other")
+
+        out2, stats2 = eng.generate(mid, p2[None, :], max_new_tokens=6,
+                                    conversation_id="conv", return_stats=True)
+        assert (out2 == ref).all()
+        # parked history covers prompt1 + tokens[:-1] -> the suffix prefill
+        # runs over exactly the unseen tail
+        covered = 12 + 6 - 1
+        assert stats2[0]["prefill_tokens"] == p2.shape[0] - covered
+        s = eng.conversation_tier.stats()
+        assert s["hits"] >= 1
+        assert s["parked_total"] >= 3  # conv x2 re-park + other
+        rt._slot_states[mid].check_page_conservation()
+    finally:
+        eng.close()
+        rt.close()
+
+
+def test_engine_multirow_conversation_ids_never_alias(tmp_path):
+    """A multi-row generate derives per-row ids ({id}#r{row}) so rows park
+    independently; each row's second turn resumes from its OWN ancestor."""
+    rt, mid = _load(tmp_path, kv_page_tokens=PT, kv_arena_pages=48)
+    eng = ContinuousGenerateEngine(
+        rt, slots=2, chunk_tokens=2, conversation_kv_bytes=32 << 20,
+    )
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, TINY["vocab_size"], (2, 9)).astype(np.int32)
+    try:
+        eng.generate(mid, ids, max_new_tokens=4, conversation_id="batch")
+        tier = eng.conversation_tier
+        assert tier.get("batch#r0", str(mid), touch=False)[1] == "hit"
+        assert tier.get("batch#r1", str(mid), touch=False)[1] == "hit"
+        assert tier.get("batch", str(mid), touch=False)[1] == "miss"
+    finally:
+        eng.close()
+        rt.close()
+
+
+def test_runtime_seeded_resume_sampling_parity(tmp_path):
+    """Resume must be SAMPLING-parity-exact, not just greedy-exact: the
+    suffix prefill shares the full prefill's rng split structure, so the
+    same seed samples the same first token over resumed pages."""
+    rt, mid = _load(tmp_path, kv_page_tokens=PT, kv_arena_pages=48)
+    eng = ContinuousGenerateEngine(
+        rt, slots=2, chunk_tokens=2, conversation_kv_bytes=32 << 20,
+    )
+    rng = np.random.default_rng(2)
+    p1 = rng.integers(1, TINY["vocab_size"], 11).astype(np.int32)
+    try:
+        out1 = eng.generate(mid, p1[None, :], max_new_tokens=5,
+                            conversation_id="conv")
+        parked, outcome = eng.conversation_tier.get("conv", str(mid),
+                                                    touch=False)
+        assert outcome == "hit"
+        p2 = np.concatenate([
+            p1, out1[0].astype(np.int32),
+            rng.integers(1, TINY["vocab_size"], 3).astype(np.int32),
+        ])
+        state = rt._slot_states[mid]
+        plan = rt.plan_conversation_resume(state, p2, parked)
+        assert plan is not None
+        covered, n_pages = plan
+        assert covered == 11 + 5 - 1
+        lane = 0
+        assert state.reserve_pages(lane, p2.shape[0] + 4)
+        try:
+            for seed in (7, 1234):
+                tok_r, _pk, _pv, _last = rt.slot_resume_prefill(
+                    mid, state, lane, p2, parked, covered, n_pages,
+                    0.8, 5, seed,
+                )
+                tok_f, _, _, _ = rt.slot_prefill(mid, p2, 0.8, 5, seed)
+                assert tok_r == tok_f
+        finally:
+            state.release_pages(lane)
+        state.check_page_conservation()
+    finally:
+        eng.close()
+        rt.close()
+
+
+# -- peer migration wire ------------------------------------------------------
+
+def test_peer_kv_stream_roundtrip_byte_exact():
+    from tfservingcache_tpu.protocol.peer_transfer import (
+        KVStreamReceiver,
+        decode_kv_request,
+        encode_kv_request,
+        iter_kv_frames,
+    )
+
+    assert decode_kv_request(encode_kv_request("conv", "lm@1")) == \
+        ("conv", "lm@1")
+    # big enough that the sender's 64 KiB chunk floor actually splits it
+    parked = _mk_parked(hist_len=23, n_pages=96, scales=True)
+    recv = KVStreamReceiver()
+    kinds = [recv.feed(f) for f in iter_kv_frames(parked, "conv", 64 << 10)]
+    assert kinds[0] == "meta" and kinds[-1] == "end"
+    assert len(kinds) > 3  # the chunk budget split the blob into >1 C frame
+    _same_parked(parked, recv.parked)
+    assert recv.meta["conversation"] == "conv"
+
+
+def test_peer_kv_stream_rejects_corruption():
+    from tfservingcache_tpu.protocol.peer_transfer import (
+        KVStreamReceiver,
+        PeerWireError,
+        iter_kv_frames,
+    )
+
+    frames = list(iter_kv_frames(_mk_parked(), "conv", 1 << 10))
+
+    recv = KVStreamReceiver()
+    recv.feed(frames[0])
+    flipped = bytearray(frames[1])
+    flipped[-1] ^= 0xFF
+    with pytest.raises(PeerWireError):
+        for f in [bytes(flipped)] + frames[2:]:
+            recv.feed(f)
+
+    # short stream: end frame before every declared byte arrived
+    recv = KVStreamReceiver()
+    recv.feed(frames[0])
+    with pytest.raises(PeerWireError):
+        recv.feed(frames[-1])
+
+    # adopted migrations count in the tier's stats
+    tier = ConversationKVTier(capacity_bytes=32 << 20)
+    try:
+        tier.adopt("conv", _mk_parked())
+        s = tier.stats()
+        assert s["migrations_in"] == 1 and s["host_conversations"] == 1
+    finally:
+        tier.close()
+
+
+# -- crash recovery through a parked ancestor --------------------------------
+
+def test_recovery_resumes_from_parked_ancestor(tmp_path):
+    """Kill the scheduler mid-turn-2: the recovered row's re-prefill goes
+    through the SAME parked ancestor (the tier lookup peeks, and the
+    recovery prompt keeps the parked history as a prefix), so the total
+    prefill work across both admissions stays below ONE cold full-prompt
+    prefill."""
+    metrics = Metrics()
+    rt, mid = _load(tmp_path, metrics=metrics, kv_page_tokens=PT,
+                    kv_arena_pages=48)
+    eng = ContinuousGenerateEngine(
+        rt, slots=2, chunk_tokens=2, metrics=metrics,
+        conversation_kv_bytes=32 << 20,
+    )
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(1, TINY["vocab_size"], 12).astype(np.int32)
+    try:
+        out1 = eng.generate(mid, p1[None, :], max_new_tokens=6,
+                            conversation_id="conv")
+        p2 = np.concatenate([
+            p1, out1[0].astype(np.int32),
+            rng.integers(1, TINY["vocab_size"], 4).astype(np.int32),
+        ])
+        # no-fault greedy reference for turn 2 under a fresh conversation
+        ref = eng.generate(mid, p2[None, :], max_new_tokens=8,
+                           conversation_id="ref")
+
+        lab_faults.arm([FaultSpec(kind="kill_engine", after=2, count=1)],
+                       metrics=metrics)
+        try:
+            out2, stats2 = eng.generate(
+                mid, p2[None, :], max_new_tokens=8,
+                conversation_id="conv", return_stats=True,
+            )
+        finally:
+            lab_faults.disarm()
+        assert (out2 == ref).all()
+        covered = 12 + 6 - 1
+        # two admissions (initial resume + post-crash recovery resume):
+        # each paid only its suffix past the parked history, so even the
+        # SUM undercuts one cold prefill of the turn-2 prompt
+        assert stats2[0]["prefill_tokens"] < p2.shape[0]
+        assert stats2[0]["prefill_tokens"] >= p2.shape[0] - covered
+        recovered = sum(
+            s.value
+            for fam in metrics.requests_recovered.collect()
+            for s in fam.samples if s.name.endswith("_total")
+        )
+        assert recovered >= 1
+        rt._slot_states[mid].check_page_conservation()
+    finally:
+        eng.close()
+        rt.close()

@@ -571,6 +571,9 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_gen_wasted_steps",
     "tpusc_group_healthy",
     "tpusc_group_reform_events",
+    "tpusc_kv_parked_bytes",
+    "tpusc_kv_parked_conversations",
+    "tpusc_kv_resume",
     "tpusc_hbm_bytes_in_use",
     "tpusc_hbm_bytes_peak",
     "tpusc_host_tier_bytes",

@@ -165,6 +165,24 @@ class ServingConfig:
     # fails on the next one (a poison prompt that deterministically crashes
     # the engine must not respawn scheduler threads forever).
     generate_max_recoveries: int = 2
+    # Conversation KV tier for generate_engine=continuous
+    # (cache/conversation_kv.py): host-RAM byte budget for PARKED decode
+    # state. A `:generate` request carrying a conversation_id parks its
+    # lane's live KV pages (raw arena dtype + int8 scales — half the dense
+    # bytes under kv_arena_dtype=int8) at retirement; the conversation's
+    # next turn resumes with a suffix-only prefill over the re-imported
+    # pages — O(new tokens) TTFT instead of a full-history re-prefill,
+    # token-identical under the exact-hit sampling discipline. 0 = off
+    # (default — requests with conversation ids behave exactly as today).
+    conversation_kv_bytes: int = 0
+    # Disk spill level under the host budget: the coldest parked
+    # conversations spill (LRU) to conversation_kv_dir instead of dropping
+    # when conversation_kv_bytes overflows; a resume that finds its turn on
+    # disk re-promotes it to host. 0 = no spill (cold conversations drop).
+    conversation_kv_disk_bytes: int = 0
+    # Directory for spilled conversation KV blobs (one file per parked
+    # conversation, atomic tmp+rename writes). Cleared on tier close.
+    conversation_kv_dir: str = "/tmp/tpusc_conv_kv"
     # ModelSpec.version_label resolution map: {model_name: {label: version}}.
     # TF Serving owns labels in its serving config (version_labels); the
     # reference forwards labeled specs verbatim for it to resolve

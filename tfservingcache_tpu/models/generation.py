@@ -666,6 +666,42 @@ def _page_copy_jit(arena_k, arena_v, scales, src, dst):
     return arena_k, arena_v, scales
 
 
+@jax.jit
+def _pages_export_jit(arena_k, arena_v, scales, pages):
+    """Gather ``n`` arena pages' RAW rows for conversation parking
+    (cache/conversation_kv.py): page-layout (layers, n, n_kv, page_tokens,
+    hd) in the arena dtype, plus the int8 arena's per-row scales
+    (layers, n, n_kv, page_tokens) when present. Read-only on the arena —
+    parking copies, it never steals — and deliberately NOT dequantized:
+    the parked bytes must re-import bit-identical, and int8 + scales is
+    half the host/disk footprint of dense rows. One compile per distinct
+    page count, bounded by pages_per_slot."""
+    k = arena_k[:, pages]
+    v = arena_v[:, pages]
+    if scales is None:
+        return k, v, None
+    return k, v, {"k": scales["k"][:, pages], "v": scales["v"][:, pages]}
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _pages_import_jit(arena_k, arena_v, scales, pages, pk, pv, pscales):
+    """Scatter parked page payloads (the `_pages_export_jit` layout) back
+    into freshly reserved arena pages — the resume half of the park cycle.
+    Donated arena buffers, batched over all pages in one dispatch; the
+    payload is already in the arena dtype so the set is a verbatim byte
+    move and a park/resume round-trip leaves every page bit-identical to a
+    lane that never retired. One compile per page count, same bound as the
+    export."""
+    arena_k = arena_k.at[:, pages].set(pk.astype(arena_k.dtype))
+    arena_v = arena_v.at[:, pages].set(pv.astype(arena_v.dtype))
+    if scales is not None:
+        scales = {
+            "k": scales["k"].at[:, pages].set(pscales["k"]),
+            "v": scales["v"].at[:, pages].set(pscales["v"]),
+        }
+    return arena_k, arena_v, scales
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg_key", "family", "chunk", "page_tokens", "kernel"),

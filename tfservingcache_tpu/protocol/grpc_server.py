@@ -124,6 +124,11 @@ class GrpcServingServer:
         # attached post-construction by CacheNode: serves this node's
         # host-tier packed entries to cold peers over FetchPackedModel
         self.peer_source = None
+        # conversation KV migration (ISSUE 18), attached post-construction
+        # by CacheNode when the continuous engine runs with a conversation
+        # tier: serves parked decode state to the peer that now owns the
+        # conversation over FetchParkedConversation
+        self.conversation_tier = None
 
     # -- handler plumbing ---------------------------------------------------
     def _unary(self, fn, req_cls, resp_cls):
@@ -260,6 +265,45 @@ class GrpcServingServer:
                 src.unpin(mid)
             src.release(peer_key)
 
+    async def _fetch_parked_kv(self, request: bytes, context: grpc.aio.ServicerContext):
+        """tpusc.internal.PeerTransfer/FetchParkedConversation: stream one
+        parked conversation's KV state (cache/conversation_kv.py) to the
+        peer that now owns the conversation after a ring rebalance.
+        NOT_FOUND = not parked here (a clean miss — the asker falls back to
+        cold prefill). The lookup does NOT touch LRU order: an outbound
+        migration read must not make a conversation look hot locally."""
+        from tfservingcache_tpu.protocol.peer_transfer import (
+            PeerWireError,
+            decode_kv_request,
+            iter_kv_frames,
+        )
+
+        tier = self.conversation_tier
+        if tier is None:
+            await context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "conversation KV tier not enabled"
+            )
+        try:
+            conversation, model = decode_kv_request(request)
+        except PeerWireError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        parked, outcome = tier.get(conversation, model, touch=False)
+        if parked is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"conversation {conversation} not parked for {model}",
+            )
+        try:
+            with TRACER.span(
+                "peer_kv_out", conversation=conversation, model=model,
+                residency=outcome,
+            ):
+                for frame in iter_kv_frames(parked, conversation, 2 << 20):
+                    yield frame
+        except PeerWireError as e:
+            log.warning("peer KV stream of %s failed: %s", conversation, e)
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+
     def _handlers(self) -> list[grpc.GenericRpcHandler]:
         b = self.backend
         impl = {
@@ -302,6 +346,23 @@ class GrpcServingServer:
                     response_serializer=lambda b: b,
                 ),
             }
+
+        # conversation KV migration rides the same service (so a peer that
+        # speaks PeerTransfer reaches both), but gates independently — a
+        # node can serve parked conversations without a host model tier
+        if self.conversation_tier is not None:
+            from tfservingcache_tpu.protocol.peer_transfer import (
+                PEER_KV_METHOD,
+                PEER_TRANSFER_SERVICE as _PTS,
+            )
+
+            per_service.setdefault(_PTS, {})[PEER_KV_METHOD] = (
+                grpc.unary_stream_rpc_method_handler(
+                    self._fetch_parked_kv,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                )
+            )
 
         per_service[HEALTH_SERVICE] = {
             "Check": grpc.unary_unary_rpc_method_handler(

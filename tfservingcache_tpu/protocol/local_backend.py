@@ -80,6 +80,9 @@ class LocalServingBackend(ServingBackend):
         spec_tokens: int = 4,
         generate_recovery: bool = True,
         generate_max_recoveries: int = 2,
+        conversation_kv_bytes: int = 0,
+        conversation_kv_disk_bytes: int = 0,
+        conversation_kv_dir: str = "/tmp/tpusc_conv_kv",
     ) -> None:
         self.manager = manager
         # engine-level speculative decoding: the continuous scheduler needs
@@ -139,6 +142,9 @@ class LocalServingBackend(ServingBackend):
                 spec_tokens=spec_tokens,
                 recovery=generate_recovery,
                 max_recoveries=generate_max_recoveries,
+                conversation_kv_bytes=conversation_kv_bytes,
+                conversation_kv_disk_bytes=conversation_kv_disk_bytes,
+                conversation_kv_dir=conversation_kv_dir,
             )
             self._spec_draft_name = str(spec_draft_model or "")
 
@@ -247,6 +253,14 @@ class LocalServingBackend(ServingBackend):
             inputs = {k: codec.tensorproto_to_numpy(v) for k, v in request.inputs.items()}
         except codec.CodecError as e:
             raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
+        if request.model_spec.signature_name == "generate":
+            # gRPC surface of the ``:generate`` verb: a PredictRequest whose
+            # signature_name is "generate" routes through the same generate
+            # core as REST (engine selection, conversation KV resume, spec
+            # decoding) — TF Serving's own Predict has no decode loop, so
+            # the signature name is the natural extension point that needs
+            # no new RPC on the wire.
+            return await self._predict_generate(model_id, request, inputs)
         output_filter = list(request.output_filter) or None
         outputs = await self._run_bounded(
             "predict", model_id, self._predict_sync, model_id, inputs, output_filter
@@ -258,6 +272,62 @@ class LocalServingBackend(ServingBackend):
             resp.model_spec.signature_name = request.model_spec.signature_name
         for name, arr in outputs.items():
             resp.outputs[name].CopyFrom(codec.numpy_to_tensorproto(arr))
+        return resp
+
+    async def _predict_generate(
+        self,
+        model_id: ModelId,
+        request: sv.PredictRequest,
+        inputs: Mapping[str, np.ndarray],
+    ) -> sv.PredictResponse:
+        """Predict(signature_name="generate"): tensor inputs map 1:1 onto
+        the REST ``:generate`` body — "input_ids" (2-D int), optional
+        "prompt_lengths" (1-D int), scalar "max_new_tokens"/"top_k"/
+        "seed"/"spec_tokens" (int), "temperature" (float), and
+        "conversation_id" (string/bytes scalar, the conversation KV tier
+        key). Response carries one "tokens" (rows, max_new_tokens) int32
+        output."""
+        if "input_ids" not in inputs:
+            raise BackendError(
+                'generate signature requires an "input_ids" input tensor',
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+        payload: dict[str, Any] = {
+            "input_ids": np.atleast_2d(np.asarray(inputs["input_ids"]))
+        }
+        if "prompt_lengths" in inputs:
+            payload["prompt_lengths"] = [
+                int(x)
+                for x in np.asarray(inputs["prompt_lengths"]).reshape(-1)
+            ]
+
+        def scalar(name: str) -> Any:
+            arr = np.asarray(inputs[name]).reshape(-1)
+            if arr.size != 1:
+                raise BackendError(
+                    f'generate input "{name}" must be a scalar',
+                    grpc.StatusCode.INVALID_ARGUMENT, 400,
+                )
+            return arr[0]
+
+        for key in ("max_new_tokens", "top_k", "seed", "spec_tokens"):
+            if key in inputs:
+                payload[key] = int(scalar(key))
+        if "temperature" in inputs:
+            payload["temperature"] = float(scalar("temperature"))
+        if "conversation_id" in inputs:
+            cid = scalar("conversation_id")
+            payload["conversation_id"] = (
+                cid.decode("utf-8", "replace")
+                if isinstance(cid, bytes) else str(cid)
+            )
+        rest = await self._rest_generate(model_id, payload)
+        tokens = np.asarray(json.loads(rest.body)["tokens"], np.int32)
+        resp = sv.PredictResponse()
+        resp.model_spec.name = model_id.name
+        resp.model_spec.version.value = model_id.version
+        resp.model_spec.signature_name = "generate"
+        resp.outputs["tokens"].CopyFrom(codec.numpy_to_tensorproto(tokens))
         return resp
 
     # -- Classify / Regress over tf.Example --------------------------------
@@ -606,8 +676,15 @@ class LocalServingBackend(ServingBackend):
         Body: {"input_ids": [[...]], "prompt_lengths": [...]?,
                "max_new_tokens": N?, "temperature": t?, "top_k": k?, "seed": s?,
                "draft_model": "name" | {"name": ..., "version"?: v}?,
-               "spec_tokens": K?}
+               "spec_tokens": K?, "conversation_id": "..."?}
         Response: {"tokens": [[...]]}.
+
+        "conversation_id" opts the request into the conversation KV tier
+        (serving.conversation_kv_bytes > 0, continuous engine only): the
+        request's decode state parks under the id at retirement and the
+        conversation's next turn resumes with a suffix-only prefill.
+        Ignored (today's behavior exactly) when the tier is off or the
+        request falls to the solo path.
 
         Omitting "seed" draws fresh entropy per request (distinct samples) and
         lets concurrent same-shape requests coalesce into one device program;
@@ -669,6 +746,17 @@ class LocalServingBackend(ServingBackend):
                 raise BackendError(str(e), grpc.StatusCode.NOT_FOUND, 404) from e
             draft_mid = ModelId(d_name, d_resolved)
 
+        conv_id = payload.get("conversation_id")
+        if conv_id is not None and (
+            not isinstance(conv_id, (str, bytes)) or not conv_id
+        ):
+            raise BackendError(
+                '"conversation_id" must be a non-empty string',
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+        if isinstance(conv_id, bytes):
+            conv_id = conv_id.decode("utf-8", "replace")
+
         def run() -> np.ndarray:
             self._ensure_sync(model_id)
             if draft_mid is not None:
@@ -703,18 +791,26 @@ class LocalServingBackend(ServingBackend):
                 )
                 arr = np.asarray(ids, np.int32)
                 if gen is not None and draft_mid is None:
+                    gkw = dict(kwargs)
+                    if conv_id is not None and getattr(
+                        gen, "conversation_tier", None
+                    ) is not None:
+                        # only the continuous engine understands the kwarg
+                        # (and only with the tier enabled) — the coalescer
+                        # keeps its narrower signature
+                        gkw["conversation_id"] = conv_id
                     try:
                         return gen.generate(
                             model_id, arr,
                             seed=int(payload["seed"]) if "seed" in payload else None,
-                            **kwargs,
+                            **gkw,
                         )
                     except ModelNotLoadedError:  # eviction raced; reload once
                         self._ensure_sync(model_id)
                         return gen.generate(
                             model_id, arr,
                             seed=int(payload["seed"]) if "seed" in payload else None,
-                            **kwargs,
+                            **gkw,
                         )
                 return self.manager.runtime.generate(
                     model_id, arr,

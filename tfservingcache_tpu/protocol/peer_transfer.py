@@ -552,3 +552,158 @@ class PeerSource:
         tier = getattr(self.runtime, "_host_tier", None)
         if tier is not None:
             tier.unpin(model_id)
+
+
+# -- conversation KV migration (ISSUE 18) ------------------------------------
+# A parked conversation (cache/conversation_kv.py ParkedConversation) rides
+# the SAME integrity-checked frame alphabet as packed models — M/C/E tags,
+# _CHUNK_HDR offsets, truncated-sha256 digests — over a second method on the
+# PeerTransfer service. The whole pack_parked() blob streams as logical
+# chunk 0 (parked state is MBs, not GBs: one hash, no segment map), so a
+# router draining a node can migrate its live conversations to the target
+# replica and the next turn resumes there with O(new tokens) prefill instead
+# of a full-history re-prefill.
+
+PEER_KV_METHOD = "FetchParkedConversation"
+PEER_KV_PATH = f"/{PEER_TRANSFER_SERVICE}/{PEER_KV_METHOD}"
+
+
+def encode_kv_request(conversation_id: str, model_id: Any) -> bytes:
+    return json.dumps(
+        {"conversation": str(conversation_id), "model": str(model_id)}
+    ).encode()
+
+
+def decode_kv_request(data: bytes) -> tuple[str, str]:
+    try:
+        req = json.loads(data.decode())
+        return str(req["conversation"]), str(req["model"])
+    except (ValueError, KeyError, UnicodeDecodeError) as e:
+        raise PeerWireError(f"bad FetchParkedConversation request: {e}") from e
+
+
+def iter_kv_frames(
+    parked: Any, conversation_id: str, chunk_msg_bytes: int
+) -> Iterator[bytes]:
+    """Sender: M frame (conversation/model/length/digest), the packed blob
+    carved into <=chunk_msg_bytes C frames (all logical chunk 0), E frame.
+    The blob is a point-in-time copy, so no pinning discipline is needed —
+    the tier's own lock made the snapshot consistent."""
+    from tfservingcache_tpu.cache.conversation_kv import pack_parked
+
+    blob = pack_parked(parked)
+    meta = {
+        "conversation": str(conversation_id),
+        "model": str(parked.model_id),
+        "nbytes": len(blob),
+        "hash": hashlib.sha256(blob).hexdigest()[:32],
+    }
+    yield bytes([FRAME_META]) + json.dumps(meta).encode()
+    step = max(int(chunk_msg_bytes), 64 << 10)
+    mv = memoryview(blob)
+    for off in range(0, len(mv), step):
+        head = bytes([FRAME_CHUNK]) + _CHUNK_HDR.pack(0, off)
+        yield b"".join((head, mv[off:off + step]))
+    yield bytes([FRAME_END]) + json.dumps({"wire_bytes": len(blob)}).encode()
+
+
+class KVStreamReceiver:
+    """Receiver: reassembles a FetchParkedConversation stream into a
+    ``ParkedConversation`` (``self.parked`` after a clean end frame),
+    verifying declared length and digest exactly like the model receiver.
+    In-memory only — parked state lands in the tier, never on disk here."""
+
+    def __init__(self) -> None:
+        self.meta: dict[str, Any] | None = None
+        self.parked: Any = None
+        self.bytes_received = 0
+        self._buf: bytearray | None = None
+        self._expect = 0
+        self._hasher = hashlib.sha256()
+
+    def feed(self, frame: bytes) -> str:
+        if not frame:
+            raise PeerWireError("empty frame")
+        kind = frame[0]
+        if kind == FRAME_META:
+            if self.meta is not None:
+                raise PeerWireError("duplicate meta frame")
+            try:
+                self.meta = json.loads(frame[1:].decode())
+                nbytes = int(self.meta["nbytes"])
+                self.meta["hash"]
+            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                raise PeerWireError(f"bad KV meta frame: {e}") from e
+            if nbytes < 0:
+                raise PeerWireError("negative KV blob length")
+            self._buf = bytearray(nbytes)
+            return "meta"
+        if kind == FRAME_CHUNK:
+            if self.meta is None or self._buf is None:
+                raise PeerWireError("chunk frame before meta")
+            body = memoryview(frame)[1:]
+            if len(body) < _CHUNK_HDR.size:
+                raise PeerWireError("truncated chunk frame")
+            ci, off = _CHUNK_HDR.unpack_from(body)
+            payload = body[_CHUNK_HDR.size:]
+            if ci != 0:
+                raise PeerWireError(f"KV stream chunk index {ci} != 0")
+            if off != self._expect:
+                raise PeerWireError(
+                    f"out-of-order KV chunk: offset {off}, "
+                    f"expected {self._expect}"
+                )
+            end = off + len(payload)
+            if end > len(self._buf):
+                raise PeerWireError(
+                    f"KV blob overruns declared length {len(self._buf)}"
+                )
+            self._hasher.update(payload)
+            self._buf[off:end] = payload
+            self._expect = end
+            self.bytes_received += len(payload)
+            return "chunk"
+        if kind == FRAME_END:
+            if self.meta is None or self._buf is None:
+                raise PeerWireError("end frame before meta")
+            if self._expect != len(self._buf):
+                raise PeerWireError(
+                    f"KV stream ended short: {self._expect} of "
+                    f"{len(self._buf)} bytes"
+                )
+            if self._hasher.hexdigest()[:32] != self.meta["hash"]:
+                raise PeerWireError("KV blob hash mismatch")
+            from tfservingcache_tpu.cache.conversation_kv import unpack_parked
+
+            self.parked = unpack_parked(bytes(self._buf))
+            return "end"
+        raise PeerWireError(f"unknown frame tag 0x{kind:02x}")
+
+
+def fetch_parked_from_peer(
+    channel,
+    conversation_id: str,
+    model_id: Any,
+    timeout_s: float | None = None,
+) -> Any:
+    """Synchronous client: pull ``conversation_id``'s parked KV for
+    ``model_id`` from the peer behind ``channel``. Returns the
+    ``ParkedConversation`` (adopt it via ``ConversationKVTier.adopt``).
+    Raises ``grpc.RpcError`` on transport/peer errors (NOT_FOUND = the peer
+    no longer holds the conversation — a clean miss, resume falls back to
+    cold prefill) and ``PeerWireError`` on integrity failures."""
+    call = channel.unary_stream(
+        PEER_KV_PATH,
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    rx = KVStreamReceiver()
+    ended = False
+    for frame in call(
+        encode_kv_request(conversation_id, model_id), timeout=timeout_s
+    ):
+        if rx.feed(frame) == "end":
+            ended = True
+    if not ended:
+        raise PeerWireError("peer KV stream closed without end frame")
+    return rx.parked

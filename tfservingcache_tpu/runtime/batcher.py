@@ -692,6 +692,16 @@ class _ContinuousReq:
     # max_recoveries the row fails instead — a prompt that deterministically
     # crashes the engine must not respawn scheduler threads forever
     recoveries: int = 0
+    # conversation KV lifecycle (ISSUE 18): rows carrying a conversation id
+    # park their decode state at retirement and resume from a parked
+    # ancestor at admission (suffix-only prefill). None = park/resume off
+    # for this row.
+    conversation_id: str | None = None
+    # tokens actually run through prefill across this row's life (every
+    # admission, including crash-recovery replays) — the O(new tokens)
+    # evidence surface: a resumed row's total stays ~suffix-sized where a
+    # cold replay pays the whole history again
+    prefill_tokens: int = 0
 
 
 @lockchecked
@@ -991,6 +1001,7 @@ class _ContinuousScheduler:
                     continue
                 plan = None
                 kind = None
+                resume = None   # (parked, covered, n_pages) when resuming
                 share = getattr(state, "prefix_index", None) is not None
                 if getattr(state, "paged", False):
                     # admission is gated on free PAGES, not just free lanes:
@@ -1017,7 +1028,25 @@ class _ContinuousScheduler:
                     idx = free[-1]  # the lane free.pop() will hand out below
                     shared_pages = ()
                     cow_headroom = 0
-                    if share:
+                    if req.conversation_id and \
+                            eng.conversation_tier is not None and \
+                            hasattr(rt, "plan_conversation_resume"):
+                        # resume beats cold prefill AND the shared-prefix
+                        # plan: parked pages cover the whole history (prompt
+                        # + prior turns' emitted tokens), where the radix
+                        # index at best covers what is still arena-resident.
+                        # The lookup PEEKS, so a lane that crashes mid-decode
+                        # can resume again from the same ancestor.
+                        parked, _outcome = eng.conversation_tier.get(
+                            req.conversation_id, str(self.model_id)
+                        )
+                        if parked is not None:
+                            rplan = rt.plan_conversation_resume(
+                                state, prompt, parked
+                            )
+                            if rplan is not None:
+                                resume = (parked, rplan[0], rplan[1])
+                    if share and resume is None:
                         plan = rt.shared_prefix_plan(state, prompt)
                         if plan is not None:
                             # map the indexed prefix read-only; reserve only
@@ -1082,7 +1111,18 @@ class _ContinuousScheduler:
                     reserved_idx = idx
                 pf0 = time.monotonic()
                 seed = secrets.randbits(31)
-                if share:
+                if resume is not None and reserved_idx is not None:
+                    # O(new tokens) turn resume: parked pages re-import into
+                    # the lane's private reservation, only the suffix past
+                    # the common history prefix runs through prefill
+                    tok, pk, pv, last = rt.slot_resume_prefill(
+                        self.model_id, state, reserved_idx, prompt,
+                        resume[0], resume[1], resume[2],
+                        req.temperature, req.top_k, seed,
+                    )
+                    kind = "resume"
+                    hit = True
+                elif share:
                     tok, pk, pv, kind, last = rt.slot_prefill_shared(
                         self.model_id, state, prompt, req.temperature,
                         req.top_k, seed, plan,
@@ -1122,6 +1162,14 @@ class _ContinuousScheduler:
                 req.first_tok_t = now
             req.prefix_hit = hit
             req.tokens.append(int(tok))
+            if kind == "exact":
+                pass  # zero prefill compute
+            elif kind == "resume":
+                req.prefill_tokens += p - resume[1]
+            elif kind == "shared":
+                req.prefill_tokens += p - plan.covered
+            else:
+                req.prefill_tokens += p
             eng.admitted += 1
             admitted_any = True
             admitted_n += 1
@@ -1131,10 +1179,13 @@ class _ContinuousScheduler:
                 prefix_hits_n += 1
                 if eng.metrics is not None:
                     # exact = radix full-skip (zero prefill compute);
-                    # shared = radix partial hit AND legacy dense-cache
-                    # reuse (both paid only a suffix prefill)
+                    # resume = parked-conversation re-import (suffix-only
+                    # prefill over re-imported pages); shared = radix
+                    # partial hit AND legacy dense-cache reuse (both paid
+                    # only a suffix prefill)
                     eng.metrics.gen_prefix_hits.labels(
-                        "continuous", "exact" if kind == "exact" else "shared"
+                        "continuous",
+                        kind if kind in ("exact", "resume") else "shared",
                     ).inc()
             if eng.metrics is not None:
                 eng.metrics.gen_admission_wait.labels("continuous").observe(
@@ -1158,6 +1209,13 @@ class _ContinuousScheduler:
                 # turn, nothing ran in between).
                 if plan is not None and plan.tail_len > 0:
                     rt.slot_cow(state, idx, plan.n_full)
+            elif kind == "resume":
+                # suffix-only insert over the re-imported pages: rows below
+                # the resume boundary already hold the parked bytes (the
+                # lane owns them privately — no trash redirect needed for
+                # correctness, but the suffix prefill only produced junk
+                # there, same as the shared case)
+                rt.slot_admit(state, idx, pk, pv, base_tokens=resume[1])
             elif plan is not None and kind == "shared":
                 # suffix-only insert: rows below the shared boundary stay in
                 # the read-only mapped pages, the jit redirects them to trash
@@ -1376,6 +1434,33 @@ class _ContinuousScheduler:
             cap = state.lane_capacity(idx)
             used = req.prompt.shape[0] + len(req.tokens)
             eng.metrics.gen_kv_page_waste.observe(max(0, cap - min(used, cap)))
+        if (
+            req.conversation_id
+            and eng.conversation_tier is not None
+            and getattr(state, "paged", False)
+            and hasattr(eng.runtime, "park_lane")
+        ):
+            # park BEFORE release: export needs the lane's page mapping.
+            # History = prompt + all-but-last emitted token: the decode step
+            # that emits token j writes the KV row for token j-1, so the
+            # last emitted token's row was never written (mid-chunk EOS
+            # leaves garbage beyond it). The next turn's prompt extends
+            # exactly this sequence, so the match walk re-covers every row.
+            try:
+                if len(req.tokens) > 1:
+                    history = np.concatenate(
+                        [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+                    )
+                else:
+                    history = req.prompt
+                parked = eng.runtime.park_lane(state, idx, history)
+                if parked is not None:
+                    eng.conversation_tier.put(req.conversation_id, parked)
+            except Exception:  # noqa: BLE001 - parking is best-effort
+                log.warning(
+                    "conversation park failed for %s", req.conversation_id,
+                    exc_info=True,
+                )
         state.release_pages(idx)
         d_st = getattr(state, "spec_draft", None)
         if d_st is not None:
@@ -1447,6 +1532,9 @@ class ContinuousGenerateEngine:
         spec_tokens: int | None = None,
         recovery: bool = True,
         max_recoveries: int = 2,
+        conversation_kv_bytes: int | None = None,
+        conversation_kv_disk_bytes: int | None = None,
+        conversation_kv_dir: str | None = None,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
@@ -1489,6 +1577,38 @@ class ContinuousGenerateEngine:
         # where it broke. max_recoveries bounds the respawn budget PER ROW.
         self.recovery = bool(recovery)
         self.max_recoveries = max(0, int(max_recoveries))
+        # conversation-grade KV lifecycle (ISSUE 18): a byte-budgeted host
+        # tier (+ optional disk spill level) holding parked decode state
+        # keyed by conversation id. None = defer to the runtime's
+        # ServingConfig (serving.conversation_kv_bytes & friends), 0 =
+        # explicitly off. The tier lives on the ENGINE, not the scheduler:
+        # parked turns survive scheduler crashes and respawns.
+        cfg = getattr(runtime, "cfg", None)
+        ckv_bytes = (
+            int(getattr(cfg, "conversation_kv_bytes", 0) or 0)
+            if conversation_kv_bytes is None else int(conversation_kv_bytes)
+        )
+        ckv_disk = (
+            int(getattr(cfg, "conversation_kv_disk_bytes", 0) or 0)
+            if conversation_kv_disk_bytes is None
+            else int(conversation_kv_disk_bytes)
+        )
+        ckv_dir = (
+            str(getattr(cfg, "conversation_kv_dir", "/tmp/tpusc_conv_kv"))
+            if conversation_kv_dir is None else str(conversation_kv_dir)
+        )
+        if ckv_bytes > 0:
+            from tfservingcache_tpu.cache.conversation_kv import (
+                ConversationKVTier,
+            )
+            self.conversation_tier = ConversationKVTier(
+                ckv_bytes,
+                disk_capacity_bytes=ckv_disk,
+                disk_dir=ckv_dir,
+                metrics=metrics,
+            )
+        else:
+            self.conversation_tier = None
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}
         self._active: dict[ModelId, int] = {}
@@ -1605,13 +1725,21 @@ class ContinuousGenerateEngine:
         top_k: int = 0,
         seed: int | None = None,
         return_stats: bool = False,
+        conversation_id: str | None = None,
     ) -> np.ndarray:
         """Drop-in for GenerateCoalescer.generate: (rows, max_new_tokens)
         int32. A row that hit EOS early is zero-padded after it (the solo
         path has no EOS concept and always fills max_new_tokens — identical
         when the model declares no eos_id). ``return_stats`` additionally
-        returns per-row timing dicts (ttft_s, admission_wait_s, tokens) —
-        the bench's streaming-TTFT surface."""
+        returns per-row timing dicts (ttft_s, admission_wait_s, tokens,
+        prefill_tokens) — the bench's streaming-TTFT surface.
+
+        ``conversation_id`` opts the request into the conversation KV tier
+        (ISSUE 18): on retirement the row's decode state parks under the id,
+        and the next turn carrying the same id resumes with a suffix-only
+        prefill. Multi-row calls get per-row ids (``"{id}#r{row}"``) so rows
+        never alias each other's parked state. A no-op when the tier is
+        disabled (conversation_kv_bytes = 0), or on the solo path."""
         ids = np.asarray(input_ids, np.int32)
         family = getattr(self.runtime, "family_of", lambda _m: None)(model_id)
         solo = (
@@ -1650,12 +1778,17 @@ class ContinuousGenerateEngine:
             )
             return (out, None) if return_stats else out
 
+        cid = str(conversation_id) if conversation_id else None
         reqs = [
             _ContinuousReq(
                 prompt=ids[r, : lengths[r]].copy(),
                 max_new=int(max_new_tokens),
                 temperature=float(temperature),
                 top_k=int(top_k),
+                conversation_id=(
+                    None if cid is None
+                    else (cid if rows == 1 else f"{cid}#r{r}")
+                ),
             )
             for r in range(rows)
         ]
@@ -1724,6 +1857,7 @@ class ContinuousGenerateEngine:
                     "admission_wait_s": (r.admitted_t or r.enqueue_t)
                     - r.enqueue_t,
                     "tokens": len(r.tokens[:max_new_tokens]),
+                    "prefill_tokens": r.prefill_tokens,
                 }
                 for r in reqs
             ]
@@ -1741,3 +1875,5 @@ class ContinuousGenerateEngine:
                 s.cv.notify_all()
         for s in scheds:
             s.thread.join(timeout=5.0)
+        if self.conversation_tier is not None:
+            self.conversation_tier.close()

@@ -2155,6 +2155,158 @@ class TPUModelRuntime(BaseRuntime):
                 freed += 1
         return freed
 
+    # -- conversation KV lifecycle (ISSUE 18) --------------------------------
+    def park_lane(self, state: SlotDecodeState, lane: int,
+                  history: np.ndarray) -> Any:
+        """Export a retiring lane's live pages for conversation parking
+        (cache/conversation_kv.py): host copies of the pages covering
+        ``history`` (the token prefix whose K/V rows are valid in the
+        lane), raw arena dtype + int8 scales — NOT dequantized, so the
+        parked bytes re-import bit-identical at half the dense footprint.
+        Read-only on the arena: the caller still release_pages() the lane
+        normally, so the conservation census never sees a parked page as a
+        new reference source. None when the lane has nothing parkable
+        (dense state, empty history, or a lane whose reservation no longer
+        covers it — a crash-recovery race, not an error)."""
+        import jax
+
+        from tfservingcache_tpu.cache.conversation_kv import ParkedConversation
+        from tfservingcache_tpu.models.generation import _pages_export_jit
+
+        if not state.paged:
+            return None
+        history = np.asarray(history, np.int32).reshape(-1)
+        if history.shape[0] <= 0:
+            return None
+        n = state.pages_needed(history.shape[0])
+        pages = state.lane_pages.get(lane)
+        if pages is None or len(pages) < n or n == 0:
+            return None
+        pg = np.asarray(pages[:n], np.int32)
+        k, v, scales = _pages_export_jit(state.k, state.v, state.scales, pg)
+        ks = vs = None
+        if scales is not None:
+            ks = np.asarray(jax.device_get(scales["k"]))
+            vs = np.asarray(jax.device_get(scales["v"]))
+        return ParkedConversation(
+            model_id=str(state.model_id),
+            history=history.copy(),
+            pages_k=np.asarray(jax.device_get(k)),
+            pages_v=np.asarray(jax.device_get(v)),
+            k_scale=ks,
+            v_scale=vs,
+            page_tokens=state.page_tokens,
+        )
+
+    def plan_conversation_resume(
+        self, state: SlotDecodeState, prompt: np.ndarray, parked: Any,
+    ) -> tuple[int, int] | None:
+        """Viability check for resuming ``prompt`` from a parked
+        conversation: -> (covered, n_pages) — the longest common
+        token prefix of the parked history and the new prompt (clamped so
+        at least one suffix token remains to prefill), and the parked
+        pages that cover it. ``covered`` need NOT be page-aligned:
+        the suffix insert's write-before-read discipline overwrites the
+        boundary page's stale tail exactly like a dense-cache hit. Sheds
+        whole pages when covered + the suffix's pow2 bucket would overflow
+        the lane (mirroring shared_prefix_plan's trim). None when nothing
+        is resumable — wrong page size / arena layout / dtype, divergent
+        first token, or the trim shed everything."""
+        if parked is None or not state.paged:
+            return None
+        if int(parked.page_tokens) != state.page_tokens:
+            return None
+        shape = tuple(parked.pages_k.shape)
+        arena = tuple(state.k.shape)
+        if len(shape) != 5 or shape[0] != arena[0] or shape[2:] != arena[2:]:
+            return None
+        if str(np.dtype(parked.pages_k.dtype)) != str(state.k.dtype):
+            return None
+        if (state.scales is None) != (parked.k_scale is None):
+            return None
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        hist = np.asarray(parked.history, np.int32).reshape(-1)
+        m = min(p - 1, hist.shape[0])
+        if m <= 0:
+            return None
+        eq = hist[:m] == prompt[:m]
+        covered = m if eq.all() else int(np.argmax(~eq))
+        # never resume past the pages actually parked
+        covered = min(covered, int(shape[1]) * state.page_tokens)
+        while covered > 0 and \
+                covered + next_bucket(p - covered) > state.max_seq:
+            covered = (state.pages_needed(covered) - 1) * state.page_tokens
+        if covered <= 0:
+            return None
+        return covered, state.pages_needed(covered)
+
+    def slot_resume_prefill(  # static-bounded: cfg_key -- one value per resident model (model_def.config)
+        self,
+        model_id: ModelId,
+        state: SlotDecodeState,
+        lane: int,
+        prompt: np.ndarray,
+        parked: Any,
+        covered: int,
+        n_pages: int,
+        temperature: float,
+        top_k: int,
+        seed: int,
+    ) -> tuple[int, Any, Any, Any]:
+        """Resume admission prefill: re-import the parked pages into the
+        first ``n_pages`` of ``lane``'s freshly reserved PRIVATE pages
+        (one batched donated scatter), gather the covered prefix dense,
+        and prefill only the suffix -> (first_token, pk, pv, last_logits),
+        with pk/pv ready for ``slot_admit(..., base_tokens=covered)``.
+        Sampling parity is the exact-hit discipline (PR 9): the same
+        split-then-sample as a full prefill under the same seed, over
+        byte-identical K/V rows — so greedy AND seeded-sampling streams
+        match a full re-prefill of the whole history."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import (
+            _paged_gather_prefix_jit,
+            _pages_import_jit,
+            _slot_prefill_from_cache_jit,
+        )
+
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        cfg = loaded.model_def.config
+        cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        pages = np.asarray(state.lane_pages[lane][:n_pages], np.int32)
+        pk_pg = np.ascontiguousarray(parked.pages_k[:, :n_pages])
+        pv_pg = np.ascontiguousarray(parked.pages_v[:, :n_pages])
+        pscales = None
+        if state.scales is not None:
+            pscales = {
+                "k": np.ascontiguousarray(parked.k_scale[:, :n_pages]),
+                "v": np.ascontiguousarray(parked.v_scale[:, :n_pages]),
+            }
+        state.k, state.v, state.scales = _pages_import_jit(
+            state.k, state.v, state.scales, pages, pk_pg, pv_pg, pscales
+        )
+        ck, cv = _paged_gather_prefix_jit(
+            state.k, state.v, state.scales, pages
+        )
+        suffix_len = p - covered
+        s_pad = next_bucket(suffix_len)
+        suffix = np.zeros((1, s_pad), np.int32)
+        suffix[0, :suffix_len] = prompt[covered:]
+        rng = jax.random.PRNGKey(seed)
+        tok, pk, pv, last = _slot_prefill_from_cache_jit(
+            loaded.params, suffix,
+            np.asarray([suffix_len], np.int32),
+            ck, cv, np.asarray([covered], np.int32),
+            rng, np.float32(temperature), np.int32(top_k),
+            cfg_key=cfg_key, family=loaded.model_def.family,
+        )
+        return int(np.asarray(tok)[0]), pk, pv, last
+
     def slot_admit(self, state: SlotDecodeState, idx: int, pk: Any, pv: Any,
                    base_tokens: int = 0) -> None:
         """Copy an admitted request's prefill K/V into slot lane ``idx``

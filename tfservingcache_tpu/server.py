@@ -185,6 +185,9 @@ class CacheNode:
                 spec_tokens=cfg.serving.spec_tokens,
                 generate_recovery=cfg.serving.generate_recovery,
                 generate_max_recoveries=cfg.serving.generate_max_recoveries,
+                conversation_kv_bytes=cfg.serving.conversation_kv_bytes,
+                conversation_kv_disk_bytes=cfg.serving.conversation_kv_disk_bytes,
+                conversation_kv_dir=cfg.serving.conversation_kv_dir,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first
@@ -211,6 +214,15 @@ class CacheNode:
                     chunk_bytes=cfg.cluster.peer_fetch_chunk_bytes,
                     max_inflight_per_peer=cfg.cluster.peer_fetch_max_inflight_per_peer,
                 )
+            # conversation KV migration (ISSUE 18): expose this group's
+            # parked decode state over FetchParkedConversation so a peer
+            # that inherits a conversation after a ring rebalance resumes
+            # it with O(new tokens) prefill instead of a cold re-prefill
+            gen_tier = getattr(
+                getattr(backend, "_generator", None), "conversation_tier", None
+            )
+            if gen_tier is not None:
+                grpc.conversation_tier = gen_tier
             group = ServingGroup(i, manager, backend, rest, grpc)
             if cfg.cluster.status_exchange:
                 # per-group status collector for the fleet exchange; built

@@ -171,6 +171,11 @@ class FlightRecorder:
         # so engine-only harnesses without a registry still get scorecard
         # fault counts.
         self._fault_counts: dict[str, int] = {}
+        # latest conversation-KV tier stats (cache/conversation_kv.py
+        # _update_gauges): parked counts/bytes/hit-rate. Rides the recorder
+        # so /monitoring/engine and tools/engine_dump.py surface the tier
+        # without a separate endpoint.
+        self._conversation_kv: dict[str, Any] | None = None
 
     def configure(
         self,
@@ -336,6 +341,17 @@ class FlightRecorder:
             ),
         }
 
+    def note_conversation_kv(self, stats: dict[str, Any]) -> None:
+        """Record the conversation-KV tier's latest stats row (called by
+        the tier on every put/evict/promote — a dict swap, not a merge, so
+        the cost is one assignment under the lock)."""
+        with self._lock:
+            self._conversation_kv = dict(stats)
+
+    def conversation_kv_stats(self) -> dict[str, Any] | None:
+        with self._lock:
+            return dict(self._conversation_kv) if self._conversation_kv else None
+
     def note_fault(self, kind: str) -> None:
         """Tally one scenario-lab fault injection (lab/faults.py). Cheap on
         purpose: injections happen at most a handful per drill, never on a
@@ -430,6 +446,9 @@ class FlightRecorder:
             "phases": phases,
             "watermarks": self.watermarks(reset=reset_watermarks),
         }
+        ckv = self.conversation_kv_stats()
+        if ckv is not None:
+            out["conversation_kv"] = ckv
         if model is not None:
             out["model_filter"] = model
             out["model_found"] = found

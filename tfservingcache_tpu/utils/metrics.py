@@ -302,6 +302,29 @@ class Metrics:
             "when unset) — capacity-vs-budget evidence for the int8 arena",
             ["dtype"], registry=r,
         )
+        # conversation KV lifecycle (cache/conversation_kv.py): parked
+        # decode state by residency tier, and how resume lookups resolve —
+        # hit = served from host DRAM, spilled = read back from the disk
+        # level (still O(new tokens) prefill, just a slower import), miss =
+        # cold full prefill.
+        self.kv_parked_bytes = Gauge(
+            "tpusc_kv_parked_bytes",
+            "Bytes of parked conversation KV state by residency tier "
+            "(tier = host | disk)",
+            ["tier"], registry=r,
+        )
+        self.kv_parked_conversations = Gauge(
+            "tpusc_kv_parked_conversations",
+            "Conversations with parked KV state across the host and disk "
+            "levels of the conversation tier",
+            registry=r,
+        )
+        self.kv_resume = Counter(
+            "tpusc_kv_resume",
+            "conversation_id resume lookups at continuous-engine admission "
+            "(outcome = hit | spilled | miss)",
+            ["outcome"], registry=r,
+        )
         self.gen_kv_page_waste = Histogram(
             "tpusc_gen_kv_page_waste_tokens",
             "Per retired row: reserved page capacity minus tokens that "

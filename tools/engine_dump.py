@@ -113,6 +113,21 @@ def render(dump: dict, max_steps: int = 32, out=sys.stdout) -> None:
         w("watermarks (high-water since last scrape):\n")
         for k in sorted(marks):
             w(f"  {k} = {marks[k]:.0f}\n")
+    ckv = dump.get("conversation_kv") or {}
+    if ckv.get("enabled"):
+        # parked-conversation tier (serving.conversation_kv_bytes): how much
+        # decode state is parked where, and how often resumes actually hit
+        w(
+            f"conversation KV: {ckv.get('host_conversations', 0)} host "
+            f"({ckv.get('host_bytes', 0):,} B) + "
+            f"{ckv.get('disk_conversations', 0)} disk "
+            f"({ckv.get('disk_bytes', 0):,} B) parked, "
+            f"hit rate={ckv.get('hit_rate', 0.0):.3f} "
+            f"({ckv.get('hits', 0)} hit / {ckv.get('spilled_hits', 0)} "
+            f"spilled / {ckv.get('misses', 0)} miss), "
+            f"{ckv.get('spills', 0)} spills, "
+            f"{ckv.get('migrations_in', 0)} migrations in\n"
+        )
     for model, data in sorted((dump.get("models") or {}).items()):
         win = data.get("window") or {}
         steps = data.get("steps") or []
