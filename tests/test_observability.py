@@ -565,8 +565,11 @@ EXPECTED_METRIC_FAMILIES = {
     "tpusc_gen_kv_pages_total",
     "tpusc_gen_kv_pages_used",
     "tpusc_gen_kv_pages_used_peak",
+    "tpusc_gen_preemptions",
+    "tpusc_gen_prefill_chunks",
     "tpusc_gen_prefix_hits",
     "tpusc_gen_oldest_queued_age_seconds",
+    "tpusc_gen_stream_frames",
     "tpusc_gen_slots_active",
     "tpusc_gen_wasted_steps",
     "tpusc_group_healthy",

@@ -69,7 +69,7 @@ class EchoBackend(ServingBackend):
         return sv.ReloadConfigResponse()
 
     async def handle_rest(self, method, model_name, version, verb, body,
-                          label=None):
+                          label=None, query=None):
         if model_name == "boom":
             raise BackendError("kaput", grpc.StatusCode.NOT_FOUND, 404)
         payload = {

@@ -18,8 +18,10 @@ random per request (90-91). Differences by design:
 from __future__ import annotations
 
 import asyncio
+import json
 import random
 import time
+import zlib
 from typing import Callable, Mapping
 
 import aiohttp
@@ -198,7 +200,10 @@ class RoutingBackend(ServingBackend):
         else:
             self._inflight[ident] = n
 
-    def _candidates(self, name: str, version: int | str | None) -> list[NodeInfo]:
+    def _candidates(
+        self, name: str, version: int | str | None,
+        affinity: str | None = None,
+    ) -> list[NodeInfo]:
         """Replica set ordered for power-of-two-choices: sample two distinct
         replicas, lead with the one carrying fewer in-flight requests, keep
         the rest as the failover rotation. Uniform-random pick of 2 + least
@@ -226,6 +231,15 @@ class RoutingBackend(ServingBackend):
             )
         if len(nodes) < 2:
             return nodes
+        if affinity is not None:
+            # resume-aware routing (ISSUE 19): a conversation's turns 2..k
+            # keep landing on the replica that parked turn 1's KV, so the
+            # suffix-only resume stays a LOCAL tier hit instead of a peer
+            # migration. crc32 — NEVER the salted builtin hash() — so every
+            # router process (and restart) picks the same replica; the
+            # failover rotation after the pinned head is unchanged.
+            start = zlib.crc32(f"{key}|{affinity}".encode()) % len(nodes)
+            return nodes[start:] + nodes[:start]
         i, j = random.sample(range(len(nodes)), 2)
         if self.fleet is not None:
             thr = self.fleet.health_threshold
@@ -423,18 +437,39 @@ class RoutingBackend(ServingBackend):
         verb: str | None,
         body: bytes,
         label: str | None = None,
+        query: dict[str, str] | None = None,
     ) -> RestResponse:
         if label is not None:
             # resolve before ring lookup; forward the concrete version
             version = self._resolve_label(model_name, label)
         if self.demand is None:
-            return await self._handle_rest_inner(method, model_name, version, verb, body)
+            return await self._handle_rest_inner(
+                method, model_name, version, verb, body, query
+            )
         key = ModelId(model_name, int(version or 0)).key
         self.demand.note_start(key)
         try:
-            return await self._handle_rest_inner(method, model_name, version, verb, body)
+            return await self._handle_rest_inner(
+                method, model_name, version, verb, body, query
+            )
         finally:
             self.demand.note_end(key)
+
+    @staticmethod
+    def _conversation_affinity(verb: str | None, body: bytes) -> str | None:
+        """Extract the ``:generate`` body's conversation_id for ring-pick
+        affinity. Bytes probe first so non-conversation traffic never pays
+        a JSON parse; a malformed body routes normally (the serving node
+        owns the 400, not the router)."""
+        if verb != "generate" or not body or b"conversation_id" not in body:
+            return None
+        try:
+            cid = json.loads(body).get("conversation_id")
+        except Exception:  # noqa: BLE001 - opaque forwarding, peer validates
+            return None
+        if isinstance(cid, str) and cid:
+            return cid
+        return None
 
     async def _handle_rest_inner(
         self,
@@ -443,15 +478,21 @@ class RoutingBackend(ServingBackend):
         version: int | None,
         verb: str | None,
         body: bytes,
+        query: dict[str, str] | None = None,
     ) -> RestResponse:
         last_err: Exception | None = None
-        for node in self._candidates(model_name, version)[: self.retries + 1]:
+        affinity = self._conversation_affinity(verb, body)
+        for node in self._candidates(model_name, version, affinity=affinity)[
+            : self.retries + 1
+        ]:
             local = self.local_backends.get(node.ident)
             if local is not None:
                 TRACER.annotate_root(route="local")
                 self._inflight_inc(node.ident)
                 try:
-                    return await local.handle_rest(method, model_name, version, verb, body)
+                    return await local.handle_rest(
+                        method, model_name, version, verb, body, query=query
+                    )
                 finally:
                     self._inflight_dec(node.ident)
             url = f"http://{node.host}:{node.rest_port}/v1/models/{model_name}"
@@ -476,8 +517,12 @@ class RoutingBackend(ServingBackend):
                 self._inflight_inc(node.ident)
                 t0 = time.monotonic()
                 try:
+                    # query rides the forwarded URL (?stream=true etc.); the
+                    # proxied stream is drained here and relayed buffered —
+                    # live frame relay is the local short-circuit's domain
                     async with self._http_session().request(
-                        method, url, data=body or None, headers=headers
+                        method, url, data=body or None, headers=headers,
+                        params=query or None,
                     ) as resp:
                         payload = await resp.read()
                         # HTTP errors (404, 412 ...) reached a live peer, so

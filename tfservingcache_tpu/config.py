@@ -88,6 +88,17 @@ class ServingConfig:
     # the cost of up to k-1 overshoot steps per finishing row (PERF.md
     # "Continuous batching" discusses the tradeoff).
     generate_chunk_tokens: int = 8
+    # Chunked prefill interleaving for generate_engine=continuous over a
+    # paged arena (ISSUE 19): 0 (default) prefills each admitted prompt in
+    # one dispatch — a 2k-token prompt monopolizes the engine for the whole
+    # prefill, inflating every other lane's inter-token latency and TTFT.
+    # > 0 splits cold-miss prefills into fixed chunks of this many tokens
+    # (clamped up to a pow2 so one compiled program serves every chunk):
+    # the lane sits in a PREFILLING state and advances one chunk per
+    # scheduler boundary while the other lanes keep decoding between
+    # chunks. Prompts that fit one chunk, shared-prefix/resume hits, and
+    # spec-draft engines take the single-dispatch path unchanged.
+    prefill_chunk_tokens: int = 0
     # Paged KV for the continuous engine. 0 (default) keeps the dense
     # per-lane slot array (slots x max_seq rows reserved per lane). > 0
     # replaces it with a shared page arena: fixed pages of kv_page_tokens

@@ -43,7 +43,7 @@ SCORECARD_FIELDS = (
     "scenario", "fault", "requests", "completed", "lost", "recovered",
     "p50_ttft_ms", "p95_ttft_ms", "p99_ttft_ms", "tok_s", "wall_s",
     "tokens_out", "goodput", "cold_miss_rate", "fault_injections",
-    "conservation_ok", "kernel_active", "platform",
+    "preemptions", "conservation_ok", "kernel_active", "platform",
 )
 
 
@@ -145,6 +145,7 @@ def run_cell(
             platform = "unknown"
 
     base_recovered = _family_sum(metrics, "tpusc_requests_recovered")
+    base_preempted = _family_sum(metrics, "tpusc_gen_preemptions")
     base_injected = _family_sum(metrics, "tpusc_fault_injected")
     base_lookups = _family_sum(metrics, "tfservingcache_cache")
     base_misses = _family_sum(metrics, "tfservingcache_cache_misses")
@@ -221,6 +222,9 @@ def run_cell(
         "goodput": round(float(engine.get("goodput", 1.0)), 4),
         "cold_miss_rate": round(misses / lookups, 4) if lookups else 0.0,
         "fault_injections": int(injected),
+        "preemptions": int(
+            _family_sum(metrics, "tpusc_gen_preemptions") - base_preempted
+        ),
         "conservation_ok": census_fn() if census_fn is not None else None,
         "kernel_active": bool(kernel_active),
         "platform": platform,

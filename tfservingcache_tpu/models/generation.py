@@ -582,6 +582,50 @@ def _paged_verify_step(params, toks, cache, tables, pos, cfg, family,
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=("cfg_key", "family", "page_tokens", "kernel"),
+    donate_argnums=(1, 2, 3),
+)
+def _paged_prefill_chunk_jit(params, arena_k, arena_v, scales, table_row,
+                             toks, start, real_len, *, cfg_key,
+                             family="transformer_lm", page_tokens,
+                             kernel=False):
+    """One fixed-size prefill chunk written straight into a lane's reserved
+    pages (chunked-prefill interleaving, serving.prefill_chunk_tokens).
+    ``toks`` is (1, C) with C STATIC — the engine clamps the knob up to a
+    pow2 and zero-pads the final chunk, so ONE compiled program serves
+    every chunk of every prompt. ``start`` (1,) i32 is the absolute
+    position of toks[:, 0]; ``real_len`` (1,) i32 counts the non-pad
+    tokens in this chunk. Reuses the spec-decode verify step: K/V rows
+    land at start..start+C-1 through the lane's block-table row (the
+    trash-page redirect inside absorbs pad rows that run past the
+    reservation), and per-position causal masks give each real query
+    exact attention over every previously written chunk. Pad rows INSIDE
+    the reservation hold junk at positions >= the prompt end — the same
+    write-before-read argument as the dense insert makes them invisible:
+    decode writes row p before any query attends to it. Returns the
+    updated arena plus the last REAL token's logits (f32), which the
+    final chunk feeds through the split-then-sample helper for a first
+    token bit-identical in discipline to the monolithic prefill."""
+    cfg = dict(cfg_key)
+    cache = {"k": arena_k, "v": arena_v}
+    if scales is not None:
+        cache["k_scale"] = scales["k"]
+        cache["v_scale"] = scales["v"]
+    logits, cache = _paged_verify_step(
+        params, toks, cache, table_row, start, cfg, family, page_tokens,
+        kernel=kernel,
+    )
+    idx = jnp.clip(real_len - 1, 0, toks.shape[1] - 1)
+    last = jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0]
+    out_scales = (
+        {"k": cache["k_scale"], "v": cache["v_scale"]}
+        if "k_scale" in cache else None
+    )
+    return cache["k"], cache["v"], out_scales, last
+
+
+@functools.partial(
     jax.jit, donate_argnums=(0, 1, 2), static_argnames=("page_tokens",)
 )
 def _paged_insert_jit(arena_k, arena_v, scales, pk, pv, table_row, base, *,

@@ -26,6 +26,12 @@ class RestResponse:
     body: bytes
     content_type: str = "application/json"
     headers: dict[str, str] = field(default_factory=dict)
+    # streaming generate (ISSUE 19): when set, ``body`` is ignored and the
+    # REST server drains this async iterator of pre-framed SSE byte chunks
+    # over a chunked-transfer StreamResponse. Status/headers still apply —
+    # they ship before the first frame, so stream-ineligible requests must
+    # fail BEFORE the backend returns (once frames flow the status is sent).
+    token_stream: object | None = None
 
 
 class BackendError(Exception):
@@ -69,6 +75,8 @@ class ServingBackend(abc.ABC):
     # REST-shaped entry point: the server has validated/parsed the URL; the
     # backend decides whether to decode the body (local) or forward it
     # opaquely (router), mirroring the reference's transparent REST proxying.
+    # ``query`` carries the request's URL query parameters (e.g.
+    # ``:generate?stream=true``) — None when the server has none to offer.
     @abc.abstractmethod
     async def handle_rest(
         self,
@@ -78,4 +86,5 @@ class ServingBackend(abc.ABC):
         verb: str | None,
         body: bytes,
         label: str | None = None,
+        query: dict[str, str] | None = None,
     ) -> RestResponse: ...

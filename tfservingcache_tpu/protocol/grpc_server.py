@@ -304,6 +304,61 @@ class GrpcServingServer:
             log.warning("peer KV stream of %s failed: %s", conversation, e)
             await context.abort(grpc.StatusCode.INTERNAL, str(e))
 
+    async def _generate_stream(
+        self, request, context: grpc.aio.ServicerContext
+    ):
+        """tensorflow.serving.PredictionService/GenerateStream (ISSUE 19):
+        server-streaming generate. Same tensor contract as
+        Predict(signature_name="generate"); one PredictResponse per sampled
+        token (scalar "token" output) then a terminal response carrying the
+        full padded "tokens" matrix. UNIMPLEMENTED on backends without a
+        ``generate_stream`` core (e.g. the routing backend)."""
+        if self.metrics is not None:
+            self.metrics.request_count.labels("grpc").inc()
+            self.metrics.requests_in_flight.labels("grpc").inc()
+        t0 = time.monotonic()
+        remote_ctx = None
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                remote_ctx = parse_traceparent(value)
+        sp = None
+        err: tuple[grpc.StatusCode, str] | None = None
+        try:
+            gen = getattr(self.backend, "generate_stream", None)
+            if gen is None:
+                err = (
+                    grpc.StatusCode.UNIMPLEMENTED,
+                    "GenerateStream not supported by this backend",
+                )
+            else:
+                with remote_parent(remote_ctx), \
+                        TRACER.span("grpc", method="generate_stream") as sp:
+                    # the span covers setup + drain: streaming duration IS
+                    # the request duration here, unlike REST's setup-only span
+                    async for resp in gen(request):
+                        yield resp
+        except BackendError as e:
+            err = (e.grpc_code or grpc.StatusCode.INTERNAL, str(e))
+        except Exception as e:  # noqa: BLE001
+            log.exception("unhandled error in generate_stream")
+            err = (grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}")
+        finally:
+            if self.metrics is not None:
+                self.metrics.requests_in_flight.labels("grpc").dec()
+                if err is not None:
+                    self.metrics.request_failures.labels("grpc").inc()
+                route = (sp.attrs.get("route") if sp is not None else None) or "local"
+                self.metrics.request_duration.labels(
+                    "grpc", "generate_stream", "ok" if err is None else "error",
+                    route,
+                ).observe(time.monotonic() - t0)
+        if remote_ctx is not None and sp is not None:
+            context.set_trailing_metadata(
+                ((TRACE_SUBTREE_TRAILER, serialize_span(sp)),)
+            )
+        if err is not None:
+            await context.abort(err[0], err[1])
+
     def _handlers(self) -> list[grpc.GenericRpcHandler]:
         b = self.backend
         impl = {
@@ -320,6 +375,18 @@ class GrpcServingServer:
         for (service, method), fn in impl.items():
             req_cls, resp_cls = METHOD_TABLE[(service, method)]
             per_service.setdefault(service, {})[method] = self._unary(fn, req_cls, resp_cls)
+
+        # streamed generate (ISSUE 19): server-streaming sibling of
+        # Predict(signature_name="generate"); registered unconditionally so
+        # router-backed servers answer UNIMPLEMENTED instead of "unknown
+        # method" (the handler gates on the backend's generate_stream)
+        per_service.setdefault(PREDICTION_SERVICE, {})["GenerateStream"] = (
+            grpc.unary_stream_rpc_method_handler(
+                self._generate_stream,
+                request_deserializer=sv.PredictRequest.FromString,
+                response_serializer=sv.PredictResponse.SerializeToString,
+            )
+        )
 
         # grpc.health.v1
         async def check(request, context):

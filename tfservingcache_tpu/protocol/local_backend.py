@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import json
+import queue as queue_mod
 import secrets
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Mapping
@@ -83,6 +84,7 @@ class LocalServingBackend(ServingBackend):
         conversation_kv_bytes: int = 0,
         conversation_kv_disk_bytes: int = 0,
         conversation_kv_dir: str = "/tmp/tpusc_conv_kv",
+        prefill_chunk_tokens: int = 0,
     ) -> None:
         self.manager = manager
         # engine-level speculative decoding: the continuous scheduler needs
@@ -145,6 +147,7 @@ class LocalServingBackend(ServingBackend):
                 conversation_kv_bytes=conversation_kv_bytes,
                 conversation_kv_disk_bytes=conversation_kv_disk_bytes,
                 conversation_kv_dir=conversation_kv_dir,
+                prefill_chunk_tokens=prefill_chunk_tokens,
             )
             self._spec_draft_name = str(spec_draft_model or "")
 
@@ -274,19 +277,10 @@ class LocalServingBackend(ServingBackend):
             resp.outputs[name].CopyFrom(codec.numpy_to_tensorproto(arr))
         return resp
 
-    async def _predict_generate(
-        self,
-        model_id: ModelId,
-        request: sv.PredictRequest,
-        inputs: Mapping[str, np.ndarray],
-    ) -> sv.PredictResponse:
-        """Predict(signature_name="generate"): tensor inputs map 1:1 onto
-        the REST ``:generate`` body — "input_ids" (2-D int), optional
-        "prompt_lengths" (1-D int), scalar "max_new_tokens"/"top_k"/
-        "seed"/"spec_tokens" (int), "temperature" (float), and
-        "conversation_id" (string/bytes scalar, the conversation KV tier
-        key). Response carries one "tokens" (rows, max_new_tokens) int32
-        output."""
+    def _generate_payload(self, inputs: Mapping[str, np.ndarray]) -> dict[str, Any]:
+        """Map generate-signature tensors onto the REST ``:generate`` body —
+        shared by unary Predict(signature_name="generate") and the
+        server-streaming GenerateStream RPC."""
         if "input_ids" not in inputs:
             raise BackendError(
                 'generate signature requires an "input_ids" input tensor',
@@ -315,12 +309,29 @@ class LocalServingBackend(ServingBackend):
                 payload[key] = int(scalar(key))
         if "temperature" in inputs:
             payload["temperature"] = float(scalar("temperature"))
-        if "conversation_id" in inputs:
-            cid = scalar("conversation_id")
-            payload["conversation_id"] = (
-                cid.decode("utf-8", "replace")
-                if isinstance(cid, bytes) else str(cid)
-            )
+        for key in ("conversation_id", "priority"):
+            if key in inputs:
+                v = scalar(key)
+                payload[key] = (
+                    v.decode("utf-8", "replace")
+                    if isinstance(v, bytes) else str(v)
+                )
+        return payload
+
+    async def _predict_generate(
+        self,
+        model_id: ModelId,
+        request: sv.PredictRequest,
+        inputs: Mapping[str, np.ndarray],
+    ) -> sv.PredictResponse:
+        """Predict(signature_name="generate"): tensor inputs map 1:1 onto
+        the REST ``:generate`` body — "input_ids" (2-D int), optional
+        "prompt_lengths" (1-D int), scalar "max_new_tokens"/"top_k"/
+        "seed"/"spec_tokens" (int), "temperature" (float), and
+        "conversation_id"/"priority" (string/bytes scalars: conversation
+        KV tier key, SLO class). Response carries one "tokens"
+        (rows, max_new_tokens) int32 output."""
+        payload = self._generate_payload(inputs)
         rest = await self._rest_generate(model_id, payload)
         tokens = np.asarray(json.loads(rest.body)["tokens"], np.int32)
         resp = sv.PredictResponse()
@@ -577,6 +588,7 @@ class LocalServingBackend(ServingBackend):
         verb: str | None,
         body: bytes,
         label: str | None = None,
+        query: dict[str, str] | None = None,
     ) -> RestResponse:
         try:
             resolved = self.manager.resolve_version(model_name, version,
@@ -607,7 +619,7 @@ class LocalServingBackend(ServingBackend):
         if verb == "predict":
             return await self._rest_predict(model_id, payload)
         if verb == "generate":
-            return await self._rest_generate(model_id, payload)
+            return await self._rest_generate(model_id, payload, query=query)
         return await self._rest_classify_regress(model_id, verb, payload)
 
     async def _rest_predict(self, model_id: ModelId, payload: dict) -> RestResponse:
@@ -670,37 +682,16 @@ class LocalServingBackend(ServingBackend):
             raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
         return RestResponse(status=200, body=body)
 
-    async def _rest_generate(self, model_id: ModelId, payload: dict) -> RestResponse:
-        """tpusc extension verb ``:generate`` — KV-cached decoding.
+    def _prepare_generate(self, model_id: ModelId, payload: dict):
+        """Validate a ``:generate`` payload and build its blocking runner.
 
-        Body: {"input_ids": [[...]], "prompt_lengths": [...]?,
-               "max_new_tokens": N?, "temperature": t?, "top_k": k?, "seed": s?,
-               "draft_model": "name" | {"name": ..., "version"?: v}?,
-               "spec_tokens": K?, "conversation_id": "..."?}
-        Response: {"tokens": [[...]]}.
-
-        "conversation_id" opts the request into the conversation KV tier
-        (serving.conversation_kv_bytes > 0, continuous engine only): the
-        request's decode state parks under the id at retirement and the
-        conversation's next turn resumes with a suffix-only prefill.
-        Ignored (today's behavior exactly) when the tier is off or the
-        request falls to the solo path.
-
-        Omitting "seed" draws fresh entropy per request (distinct samples) and
-        lets concurrent same-shape requests coalesce into one device program;
-        pass an explicit seed for reproducible (solo) completions.
-
-        "draft_model" enables greedy speculative decoding (temperature must
-        be 0): the draft proposes spec_tokens tokens per round, the target
-        verifies them in one chunked forward — output is bit-identical to
-        the target's own greedy decode. Speculative requests run solo
-        (never coalesced).
-
-        The whole request — cold load AND the generate program itself — is
-        deadline-bounded by the manager's ``load_timeout_s``: a hung or
-        pathologically slow generate answers 504, it does not wedge the
-        client (VERDICT r2 weak #7).
-        """
+        Returns ``(run, rows)``: ``run(on_token=None)`` executes the whole
+        generate on a pool thread (ensure + engine dispatch) and returns the
+        padded token matrix; ``rows`` is the request's row count (streaming
+        is single-row only). All client-input validation raises BackendError
+        HERE, before any streaming response has shipped its status line —
+        errors raised inside ``run`` itself surface as terminal stream
+        frames instead."""
         ids = payload.get("input_ids")
         if isinstance(ids, np.ndarray):
             # pre-extracted by the native request parser; float arrays stay
@@ -757,7 +748,26 @@ class LocalServingBackend(ServingBackend):
         if isinstance(conv_id, bytes):
             conv_id = conv_id.decode("utf-8", "replace")
 
-        def run() -> np.ndarray:
+        # SLO class (ISSUE 19): admission ordering + preemption rights in
+        # the continuous engine; validated here so bad classes answer 400
+        # on every surface (the coalescer/solo paths accept-and-ignore it,
+        # priority has no meaning without a shared scheduler to contend on)
+        priority = payload.get("priority", "normal")
+        if isinstance(priority, bytes):
+            priority = priority.decode("utf-8", "replace")
+        if priority not in ("high", "normal", "low"):
+            raise BackendError(
+                '"priority" must be one of "high", "normal", "low"',
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+
+        try:
+            rows = int(np.atleast_2d(np.asarray(ids)).shape[0])
+        except (ValueError, TypeError):
+            # ragged rows: let run()'s own int32 conversion produce the 400
+            rows = len(ids) if isinstance(ids, list) else 1
+
+        def run(on_token=None) -> np.ndarray:
             self._ensure_sync(model_id)
             if draft_mid is not None:
                 self._ensure_sync(draft_mid)
@@ -799,6 +809,13 @@ class LocalServingBackend(ServingBackend):
                         # (and only with the tier enabled) — the coalescer
                         # keeps its narrower signature
                         gkw["conversation_id"] = conv_id
+                    if hasattr(gen, "prefill_chunk_tokens"):
+                        # continuous engine only: the coalescer has neither
+                        # priority classes nor a live token callback
+                        if priority != "normal":
+                            gkw["priority"] = priority
+                        if on_token is not None:
+                            gkw["on_token"] = on_token
                     try:
                         return gen.generate(
                             model_id, arr,
@@ -826,13 +843,200 @@ class LocalServingBackend(ServingBackend):
             except (ValueError, TypeError) as e:
                 raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
 
+        return run, rows
+
+    async def _rest_generate(
+        self, model_id: ModelId, payload: dict,
+        query: dict[str, str] | None = None,
+    ) -> RestResponse:
+        """tpusc extension verb ``:generate`` — KV-cached decoding.
+
+        Body: {"input_ids": [[...]], "prompt_lengths": [...]?,
+               "max_new_tokens": N?, "temperature": t?, "top_k": k?, "seed": s?,
+               "draft_model": "name" | {"name": ..., "version"?: v}?,
+               "spec_tokens": K?, "conversation_id": "..."?,
+               "priority": "high"|"normal"|"low"?}
+        Response: {"tokens": [[...]]}.
+
+        "conversation_id" opts the request into the conversation KV tier
+        (serving.conversation_kv_bytes > 0, continuous engine only): the
+        request's decode state parks under the id at retirement and the
+        conversation's next turn resumes with a suffix-only prefill.
+        Ignored (today's behavior exactly) when the tier is off or the
+        request falls to the solo path.
+
+        "priority" (default "normal") orders continuous-engine admission by
+        class and lets a "high" arrival preempt a lower-class decoding lane
+        when the page arena is full (ISSUE 19). Other engines accept and
+        ignore it — without a shared scheduler there is nothing to contend.
+
+        ``?stream=true`` (single-row requests only) switches the response to
+        Server-Sent Events over chunked transfer: one ``{"token": N}`` frame
+        per generated token as it is sampled, then a terminal
+        ``{"done": true, "tokens": [[...]]}`` frame carrying the same padded
+        matrix the buffered response would have returned. Engines without a
+        live token callback (coalescer, solo runtime) replay the finished
+        row as frames — same wire shape, no early delivery.
+
+        Omitting "seed" draws fresh entropy per request (distinct samples) and
+        lets concurrent same-shape requests coalesce into one device program;
+        pass an explicit seed for reproducible (solo) completions.
+
+        "draft_model" enables greedy speculative decoding (temperature must
+        be 0): the draft proposes spec_tokens tokens per round, the target
+        verifies them in one chunked forward — output is bit-identical to
+        the target's own greedy decode. Speculative requests run solo
+        (never coalesced).
+
+        The whole buffered request — cold load AND the generate program — is
+        deadline-bounded by the manager's ``load_timeout_s``: a hung or
+        pathologically slow generate answers 504, it does not wedge the
+        client (VERDICT r2 weak #7). Streaming requests are exempt from the
+        end-to-end bound (a long stream is healthy, not hung): liveness is
+        the client's per-frame concern.
+        """
+        stream = bool(query) and str(query.get("stream", "")).strip().lower() in (
+            "1", "true", "yes", "on"
+        )
+        run, rows = self._prepare_generate(model_id, payload)
+        if not stream:
+            try:
+                tokens = await self._run_bounded("generate", model_id, run)
+            except GroupUnhealthyError as e:
+                raise BackendError(str(e), grpc.StatusCode.UNAVAILABLE, 503) from e
+            except RuntimeError_ as e:
+                raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
+            return RestResponse(
+                status=200, body=json.dumps({"tokens": tokens.tolist()}).encode()
+            )
+        if rows != 1:
+            raise BackendError(
+                "?stream=true requires a single-row request",
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+        return RestResponse(
+            status=200,
+            body=b"",
+            content_type="text/event-stream",
+            headers={"cache-control": "no-cache"},
+            token_stream=self._sse_frames(self._stream_events(run)),
+        )
+
+    # -- streaming generate core (ISSUE 19) ---------------------------------
+    async def _stream_events(self, run):
+        """Run a prepared generate on the pool; yield ``("tok", t)`` events
+        live as the engine samples, then a terminal ``("end", rows_list)``.
+
+        The engine's ``on_token`` callback fires on the scheduler thread, so
+        a thread-safe queue is the seam: callback puts, this coroutine
+        drains via the default executor (NOT the serving pool — a saturated
+        pool must not be able to starve the drain of an in-flight stream).
+        Engines with no callback support emit nothing until completion; the
+        finished row is replayed as token events so every engine speaks the
+        same frame sequence. Errors inside the generate surface as a raised
+        exception after the frames already sent — the protocol layer turns
+        it into a terminal error frame."""
+        q: queue_mod.Queue = queue_mod.Queue()
+
+        def on_token(t) -> None:
+            q.put(("tok", int(t)))
+
+        def worker() -> None:
+            try:
+                out = run(on_token)
+                q.put(("end", np.atleast_2d(np.asarray(out)).tolist()))
+            except BaseException as e:  # noqa: BLE001 - forwarded to client
+                q.put(("err", e))
+
+        loop = asyncio.get_running_loop()
+        task = asyncio.ensure_future(self._run(worker))
         try:
-            tokens = await self._run_bounded("generate", model_id, run)
-        except GroupUnhealthyError as e:
-            raise BackendError(str(e), grpc.StatusCode.UNAVAILABLE, 503) from e
-        except RuntimeError_ as e:
-            raise BackendError(str(e), grpc.StatusCode.FAILED_PRECONDITION, 400) from e
-        return RestResponse(status=200, body=json.dumps({"tokens": tokens.tolist()}).encode())
+            streamed = 0
+            while True:
+                kind, val = await loop.run_in_executor(None, q.get)
+                if kind == "tok":
+                    streamed += 1
+                    yield ("tok", val)
+                elif kind == "end":
+                    if streamed == 0 and val and val[0]:
+                        # callback-less engine: replay the finished row so
+                        # streamed output is engine-independent
+                        for t in val[0]:
+                            yield ("tok", int(t))
+                    yield ("end", val)
+                    return
+                else:
+                    raise val
+        finally:
+            # the worker traps everything onto the queue, so the task never
+            # raises — retrieve its (non-)result to keep the loop's books
+            # clean; on early close (client gone) it just drains in the pool
+            if task.done() and not task.cancelled():
+                task.exception()
+
+    async def _sse_frames(self, events):
+        """Frame ``_stream_events`` output as SSE byte chunks."""
+        m = getattr(self.manager, "metrics", None)
+        try:
+            async for kind, val in events:
+                if m is not None:
+                    m.gen_stream_frames.labels("sse").inc()
+                if kind == "tok":
+                    yield b'data: {"token": %d}\n\n' % val
+                else:
+                    yield (
+                        b"data: "
+                        + json.dumps({"done": True, "tokens": val}).encode()
+                        + b"\n\n"
+                    )
+        except BaseException as e:  # noqa: BLE001 - status already shipped
+            # mid-stream failure: the 200 + frames are on the wire, so the
+            # only honest signal left is a terminal error frame
+            log.warning("generate stream aborted: %s", e)
+            yield (
+                b"data: "
+                + json.dumps({"error": str(e) or type(e).__name__}).encode()
+                + b"\n\n"
+            )
+
+    async def generate_stream(self, request: sv.PredictRequest):
+        """gRPC server-streaming generate (ISSUE 19): same tensor contract
+        as Predict(signature_name="generate"), but tokens flow back one
+        PredictResponse per sampled token (scalar int32 output "token"),
+        then a terminal response carrying the full padded "tokens" matrix —
+        so a client that only reads the last message sees exactly the unary
+        response. Single-row requests only."""
+        model_id = self._model_id(request.model_spec)
+        try:
+            inputs = {
+                k: codec.tensorproto_to_numpy(v) for k, v in request.inputs.items()
+            }
+        except codec.CodecError as e:
+            raise BackendError(str(e), grpc.StatusCode.INVALID_ARGUMENT, 400) from e
+        payload = self._generate_payload(inputs)
+        run, rows = self._prepare_generate(model_id, payload)
+        if rows != 1:
+            raise BackendError(
+                "GenerateStream requires a single-row request",
+                grpc.StatusCode.INVALID_ARGUMENT, 400,
+            )
+        m = getattr(self.manager, "metrics", None)
+        async for kind, val in self._stream_events(run):
+            resp = sv.PredictResponse()
+            resp.model_spec.name = model_id.name
+            resp.model_spec.version.value = model_id.version
+            resp.model_spec.signature_name = "generate"
+            if kind == "tok":
+                resp.outputs["token"].CopyFrom(
+                    codec.numpy_to_tensorproto(np.asarray(val, np.int32))
+                )
+            else:
+                resp.outputs["tokens"].CopyFrom(
+                    codec.numpy_to_tensorproto(np.asarray(val, np.int32))
+                )
+            if m is not None:
+                m.gen_stream_frames.labels("grpc").inc()
+            yield resp
 
     async def _rest_classify_regress(
         self, model_id: ModelId, verb: str, payload: dict

@@ -282,7 +282,8 @@ class RestServingServer:
             with remote_parent(remote_ctx), \
                     TRACER.span("rest", path=path, method=request.method) as sp:
                 resp: RestResponse = await self.backend.handle_rest(
-                    request.method, name, version, verb, body, label=label
+                    request.method, name, version, verb, body, label=label,
+                    query=dict(request.query),
                 )
         except BackendError as e:
             response = self._fail(web.Response(
@@ -300,6 +301,12 @@ class RestServingServer:
         else:
             if resp.status >= 400 and self.metrics is not None:
                 self.metrics.request_failures.labels("rest").inc()
+            if getattr(resp, "token_stream", None) is not None:
+                # streaming generate (ISSUE 19): headers ship on prepare(),
+                # so the trace/status piggyback must attach before the drain
+                return await self._stream_rest(
+                    request, resp, sp, remote_ctx
+                ), sp, verb_label
             response = web.Response(
                 status=resp.status,
                 body=resp.body,
@@ -321,6 +328,44 @@ class RestServingServer:
             if blob:
                 response.headers[STATUS_HEADER] = blob
         return response, sp, verb_label
+
+    async def _stream_rest(
+        self, request: web.Request, resp: RestResponse, sp, remote_ctx
+    ) -> web.StreamResponse:
+        """Drain a backend ``token_stream`` over chunked transfer (SSE).
+
+        The 200 + headers are committed at ``prepare()`` — before the first
+        token exists — which is why the backend front-loads every validation
+        before returning a streaming response. A client disconnect stops the
+        drain without error: the generate itself keeps finishing in the
+        backend's pool."""
+        headers = dict(resp.headers)
+        headers["Content-Type"] = resp.content_type
+        stream = web.StreamResponse(status=resp.status, headers=headers)
+        if remote_ctx is not None and sp is not None:
+            stream.headers[TRACE_SUBTREE_HEADER] = serialize_span(sp)
+        if (
+            self.status_collector is not None
+            and request.headers.get(STATUS_WANT_HEADER)
+        ):
+            blob = self.status_collector.encoded()
+            if blob:
+                stream.headers[STATUS_HEADER] = blob
+        await stream.prepare(request)
+        try:
+            async for frame in resp.token_stream:
+                await stream.write(frame)
+            await stream.write_eof()
+        except (ConnectionResetError, ConnectionError):
+            log.info("generate stream client disconnected mid-stream")
+        finally:
+            aclose = getattr(resp.token_stream, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 - already answered/gone
+                    pass
+        return stream
 
     async def _capture_profile(self, request: web.Request) -> web.Response:
         """Capture a JAX/XLA device profile for ``duration_s`` into ``dir``

@@ -40,7 +40,7 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Mapping
+from typing import Any, Callable, Mapping
 
 import numpy as np
 
@@ -440,6 +440,19 @@ class GenerateCoalescer:
     ) -> np.ndarray:
         ids = np.asarray(input_ids, np.int32)
         family = getattr(self.runtime, "family_of", lambda _m: None)(model_id)
+        if ids.ndim == 2 and family == "transformer_lm":
+            # oversized prompts must fail loudly AT SUBMIT (mirroring the
+            # continuous engine): before this check they joined a pending
+            # batch, the leader's drain raised for everyone, and joiners
+            # saw only an opaque timeout after wait_timeout_s
+            max_seq = getattr(
+                self.runtime, "max_seq_of", lambda _m: None
+            )(model_id)
+            if max_seq is not None and ids.shape[1] + max_new_tokens > max_seq:
+                raise ValueError(
+                    f"prompt {ids.shape[1]} + max_new_tokens "
+                    f"{max_new_tokens} exceeds max_seq {max_seq}"
+                )
         if (
             seed is not None
             or ids.ndim != 2
@@ -669,6 +682,12 @@ class GenerateCoalescer:
         )
 
 
+# priority classes for the continuous engine's SLO-aware admission
+# (REST/gRPC `priority`, default normal): rank order is what admission and
+# preemption compare — smaller rank wins pages
+_PRIORITY_RANKS = {"high": 0, "normal": 1, "low": 2}
+
+
 @dataclass
 class _ContinuousReq:
     """One ROW of a continuous generate (multi-row requests split into
@@ -702,6 +721,31 @@ class _ContinuousReq:
     # evidence surface: a resumed row's total stays ~suffix-sized where a
     # cold replay pays the whole history again
     prefill_tokens: int = 0
+    # SLO-aware engine (ISSUE 19). priority class -> rank (high=0, normal=1,
+    # low=2); admission picks min (rank, seq), so all-normal traffic
+    # degenerates to today's exact FIFO (seq is engine-monotonic and
+    # survives preemption/crash requeues).
+    priority: str = "normal"
+    rank: int = 1
+    seq: int = 0
+    # per-token stream callback (single-row requests only; exceptions are
+    # swallowed once and the callback dropped — a broken client must not
+    # kill the scheduler thread)
+    on_token: Callable[[int], None] | None = None
+    # times this row was preempted off a lane (bounded by
+    # engine.preempt_limit so a page-starved class can't be parked forever)
+    preemptions: int = 0
+    # ParkedConversation from a preemption park — checked at re-admission
+    # BEFORE the conversation tier, giving the O(new tokens) resume without
+    # requiring the row to carry a conversation_id
+    preempt_parked: Any = None
+    # chunked-prefill carry (serving.prefill_chunk_tokens > 0): tokens of
+    # pf_prompt written so far (None = not PREFILLING), the full prompt
+    # being written (includes crash-recovered emitted tokens), and the
+    # first-token seed drawn at admission
+    pf_pos: int | None = None
+    pf_prompt: np.ndarray | None = None
+    pf_seed: int = 0
 
 
 @lockchecked
@@ -781,6 +825,11 @@ class _ContinuousScheduler:
                 if r.recoveries > eng.max_recoveries:
                     doomed.append(r)
                     continue
+                # a row caught mid chunked-prefill restarts from chunk 0 on
+                # the fresh scheduler (the crashed state's pages are gone);
+                # stale carry would make re-admission treat it as PREFILLING
+                r.pf_pos = None
+                r.pf_prompt = None
                 survivors.append(r)
                 if eng.metrics is not None:
                     eng.metrics.requests_recovered.labels(reason).inc()
@@ -946,7 +995,20 @@ class _ContinuousScheduler:
             with self.cv:
                 if not self.pending:
                     break
-                req = self.pending.popleft()
+                # admission orders by (priority rank, submit seq): strict
+                # class precedence, FIFO inside a class. With every queued
+                # row the same class this is min-seq = the leftmost row —
+                # exactly the old popleft, so priority-free traffic keeps
+                # its byte-identical admission order. O(n) scan; the queue
+                # is bounded by client concurrency.
+                best = 0
+                for qi in range(1, len(self.pending)):
+                    r = self.pending[qi]
+                    b = self.pending[best]
+                    if (r.rank, r.seq) < (b.rank, b.seq):
+                        best = qi
+                req = self.pending[best]
+                del self.pending[best]
                 if eng.metrics is not None:
                     eng.metrics.batcher_queue_depth.labels("generate").dec()
             reserved_idx = None
@@ -1028,7 +1090,19 @@ class _ContinuousScheduler:
                     idx = free[-1]  # the lane free.pop() will hand out below
                     shared_pages = ()
                     cow_headroom = 0
-                    if req.conversation_id and \
+                    if req.preempt_parked is not None and \
+                            hasattr(rt, "plan_conversation_resume"):
+                        # preempted row coming back: its own parked pages
+                        # beat both the conversation tier and the radix
+                        # index — they cover prompt + every emitted token,
+                        # so the resume prefill is O(1) (the single row the
+                        # park could not cover)
+                        rplan = rt.plan_conversation_resume(
+                            state, prompt, req.preempt_parked
+                        )
+                        if rplan is not None:
+                            resume = (req.preempt_parked, rplan[0], rplan[1])
+                    if resume is None and req.conversation_id and \
                             eng.conversation_tier is not None and \
                             hasattr(rt, "plan_conversation_resume"):
                         # resume beats cold prefill AND the shared-prefix
@@ -1088,6 +1162,38 @@ class _ContinuousScheduler:
                         if not d_st.reserve_pages(idx, d_budget):
                             state.release_pages(idx)
                             ok = False
+                    if not ok and hasattr(rt, "park_lane"):
+                        # priority preemption (ISSUE 19): a higher-class
+                        # arrival that still can't reserve parks the
+                        # lowest-class decoding lane's KV (pages are COPIES
+                        # through the PR 18 codec, so the conservation
+                        # census stays exact), requeues it for an
+                        # O(new tokens) parked-KV resume, and retries the
+                        # reservation. One victim may not free enough —
+                        # keep hunting until the reserve succeeds or no
+                        # preemptible lane remains.
+                        while not ok:
+                            vidx = self._pick_victim(lanes, req)
+                            if vidx is None or not self._preempt(
+                                rt, state, lanes, vidx
+                            ):
+                                break
+                            # the victim's lane frees too — at the FRONT of
+                            # the free list, so free[-1] (the lane reserved
+                            # as `idx` above) is untouched
+                            free.insert(0, vidx)
+                            ok = state.reserve_pages(
+                                idx, budget, shared_pages, cow_headroom
+                            )
+                            if ok and d_st is not None:
+                                d_budget = min(
+                                    budget,
+                                    d_st.pages_per_slot * d_st.page_tokens,
+                                )
+                                if not d_st.reserve_pages(idx, d_budget):
+                                    state.release_pages(idx)
+                                    ok = False
+                                    break
                     if not ok:
                         # arena exhausted: the queue BLOCKS, never fails —
                         # the row goes back to the FRONT (FIFO preserved)
@@ -1111,6 +1217,43 @@ class _ContinuousScheduler:
                     reserved_idx = idx
                 pf0 = time.monotonic()
                 seed = secrets.randbits(31)
+                if (
+                    reserved_idx is not None
+                    and eng.prefill_chunk_tokens > 0
+                    and resume is None and plan is None and d_st is None
+                    and p > eng.prefill_chunk_tokens
+                    and hasattr(rt, "slot_prefill_chunk")
+                ):
+                    # chunked-prefill interleaving (ISSUE 19): pages are
+                    # reserved but NOTHING is written yet — the lane enters
+                    # its PREFILLING state and _prefill_phase advances it
+                    # one fixed-size chunk per boundary while other lanes
+                    # keep decoding between chunks. pos holds the past-
+                    # reservation sentinel so the decode chunk's frozen
+                    # rewrite of this inactive lane hits the trash-page
+                    # redirect, never the reserved rows the chunks fill.
+                    # Resume/shared hits and spec-draft engines keep the
+                    # single-dispatch path (their prefill is already the
+                    # short suffix, or the draft arena must mirror it).
+                    idx = free.pop()
+                    req.pf_prompt = prompt
+                    req.pf_pos = 0
+                    req.pf_seed = seed
+                    now = time.monotonic()
+                    req.admitted_t = now
+                    state.active[idx] = False
+                    state.pos[idx] = state.pages_per_slot * state.page_tokens
+                    state.temps[idx] = req.temperature
+                    state.topks[idx] = req.top_k
+                    lanes[idx] = req
+                    eng.admitted += 1
+                    admitted_any = True
+                    admitted_n += 1
+                    if eng.metrics is not None:
+                        eng.metrics.gen_admission_wait.labels(
+                            "continuous"
+                        ).observe(max(0.0, now - req.enqueue_t))
+                    continue
                 if resume is not None and reserved_idx is not None:
                     # O(new tokens) turn resume: parked pages re-import into
                     # the lane's private reservation, only the suffix past
@@ -1122,6 +1265,7 @@ class _ContinuousScheduler:
                     )
                     kind = "resume"
                     hit = True
+                    req.preempt_parked = None
                 elif share:
                     tok, pk, pv, kind, last = rt.slot_prefill_shared(
                         self.model_id, state, prompt, req.temperature,
@@ -1161,7 +1305,7 @@ class _ContinuousScheduler:
                 # its first token before the crash
                 req.first_tok_t = now
             req.prefix_hit = hit
-            req.tokens.append(int(tok))
+            self._emit(req, int(tok))
             if kind == "exact":
                 pass  # zero prefill compute
             elif kind == "resume":
@@ -1240,11 +1384,27 @@ class _ContinuousScheduler:
             eng._set_active(
                 self.model_id, sum(l is not None for l in lanes)
             )
+        pf_chunks = 0
+        if eng.prefill_chunk_tokens > 0 and state is not None:
+            # chunked-prefill interleave: every PREFILLING lane advances
+            # exactly ONE chunk per boundary, so a long prompt's prefill is
+            # spread across boundaries instead of monopolizing one dispatch
+            pf_chunks, pf_toks, pf_s, pf_retired = self._prefill_phase(
+                rt, state, lanes, eos
+            )
+            retired_n += pf_retired
+            prefill_s_sum += pf_s
+            tokens_in_n += pf_toks
+            if pf_retired:
+                eng._set_active(
+                    self.model_id, sum(l is not None for l in lanes)
+                )
         self._update_page_gauge(state)
-        if not any(l is not None for l in lanes):
-            if admitted_n or retired_n:
+        if not any(l is not None and l.pf_pos is None for l in lanes):
+            if admitted_n or retired_n or pf_chunks:
                 # prefill-only boundary (every admitted row finished at its
-                # first token): still a ring entry, with no chunk dispatched
+                # first token, or every occupied lane is still PREFILLING):
+                # still a ring entry, with no chunk dispatched
                 self._record_step(
                     state, 0, 0, admitted_n, retired_n, 0, step_t0,
                     prefix_hits_n, prefill_s_sum, tokens_in_n,
@@ -1252,12 +1412,17 @@ class _ContinuousScheduler:
             return state
         # chunk clamped to the pow2 cover of the largest remaining budget:
         # when every active row needs < chunk_tokens more, a smaller
-        # compiled chunk (log2-bounded program count) trims the overshoot
+        # compiled chunk (log2-bounded program count) trims the overshoot.
+        # PREFILLING lanes are excluded everywhere below — the decode jit
+        # freezes them (active=False) and their emit rows are junk.
         max_remaining = max(
-            l.max_new - len(l.tokens) for l in lanes if l is not None
+            l.max_new - len(l.tokens)
+            for l in lanes if l is not None and l.pf_pos is None
         )
         chunk = max(1, min(eng.chunk_tokens, _next_bucket(max_remaining)))
-        active_rows = sum(l is not None for l in lanes)
+        active_rows = sum(
+            l is not None and l.pf_pos is None for l in lanes
+        )
         d_st = getattr(state, "spec_draft", None)
         use_spec = (
             d_st is not None
@@ -1325,7 +1490,7 @@ class _ContinuousScheduler:
         drafted = spec_span * active_rows if accept is not None else 0
         accepted = int(accept.sum()) if accept is not None else 0
         for idx, req in enumerate(lanes):
-            if req is None:
+            if req is None or req.pf_pos is not None:
                 continue
             # spec rounds emit a VARIABLE per-row prefix (the accepted
             # draft run + the verify's correction token); plain chunks
@@ -1333,7 +1498,7 @@ class _ContinuousScheduler:
             n_emit = chunk if accept is None else int(accept[idx])
             for j in range(n_emit):
                 t = int(toks[idx, j])
-                req.tokens.append(t)
+                self._emit(req, t)
                 if (eos is not None and t == eos) or len(req.tokens) >= req.max_new:
                     # retire NOW: steps the chunk computed past this point
                     # were for a finished request — the waste continuous
@@ -1469,6 +1634,164 @@ class _ContinuousScheduler:
             # free-list, keeping the draft-side conservation census exact
             d_st.release_pages(idx)
 
+    @staticmethod
+    def _emit(req: _ContinuousReq, tok: int) -> None:
+        """Append one emitted token and fire the row's stream callback (the
+        SSE / gRPC-stream frame writers hang off it). A callback that raises
+        is dropped after one failure — a dead client connection must not
+        take the scheduler thread (and every other lane) down with it."""
+        req.tokens.append(tok)
+        cb = req.on_token
+        if cb is not None:
+            try:
+                cb(tok)
+            except Exception:  # noqa: BLE001 - client callback, not engine state
+                req.on_token = None
+
+    def _prefill_phase(
+        self, rt, state, lanes, eos
+    ) -> tuple[int, int, float, int]:
+        """Advance every PREFILLING lane by exactly ONE fixed-size chunk
+        (scheduler-thread only; called between admission and the decode
+        half). The final chunk samples the row's first token under the seed
+        drawn at admission — the same split-then-sample as a monolithic
+        prefill — then activates the lane for the next boundary's decode
+        chunk (or retires it on immediate EOS / max_new == 1). Returns
+        (chunks_run, tokens_written, prefill_seconds, retired)."""
+        eng = self.engine
+        chunk_size = eng.prefill_chunk_tokens
+        chunks = 0
+        toks_in = 0
+        prefill_s = 0.0
+        retired = 0
+        for idx, req in enumerate(lanes):
+            if req is None or req.pf_pos is None:
+                continue
+            prompt = req.pf_prompt
+            p = prompt.shape[0]
+            t0 = time.monotonic()
+            n = min(chunk_size, p - req.pf_pos)
+            last = rt.slot_prefill_chunk(
+                self.model_id, state, idx,
+                prompt[req.pf_pos:req.pf_pos + n], req.pf_pos, chunk_size,
+            )
+            req.pf_pos += n
+            dt = time.monotonic() - t0
+            req.prefill_s += dt
+            prefill_s += dt
+            toks_in += n
+            chunks += 1
+            if eng.metrics is not None:
+                eng.metrics.gen_prefill_chunks.inc()
+            if req.pf_pos < p:
+                continue
+            tok = rt.sample_first_token(
+                last, req.temperature, req.top_k, req.pf_seed
+            )
+            now = time.monotonic()
+            if req.first_tok_t is None:
+                req.first_tok_t = now
+            req.prefill_tokens += p
+            req.pf_pos = None
+            req.pf_prompt = None
+            remaining = req.max_new - len(req.tokens)
+            self._emit(req, int(tok))
+            if getattr(state, "prefix_index", None) is not None:
+                # same publish the monolithic cold path does, just at the
+                # last chunk: later same-prefix admissions map these pages
+                rt.shared_prefix_publish(state, idx, prompt, last)
+            if (eos is not None and int(tok) == eos) or remaining <= 1:
+                lanes[idx] = None
+                self._retire_pages(state, idx, req)
+                req.finish_t = now
+                req.done.set()
+                retired += 1
+                continue
+            state.tok[idx] = int(tok)
+            state.pos[idx] = p
+            state.active[idx] = True
+        return chunks, toks_in, prefill_s, retired
+
+    def _pick_victim(
+        self, lanes, req: _ContinuousReq
+    ) -> int | None:
+        """The preemption target for ``req``: the decoding lane with the
+        numerically largest rank strictly above the arrival's (low loses to
+        normal loses to high), youngest submit last — matching admission's
+        (rank, seq) order in reverse. PREFILLING lanes are exempt (nothing
+        decodable to park yet) and so are lanes out of preemption budget."""
+        eng = self.engine
+        best = None
+        for li, lreq in enumerate(lanes):
+            if lreq is None or lreq.pf_pos is not None:
+                continue
+            if lreq.rank <= req.rank:
+                continue
+            if lreq.preemptions >= eng.preempt_limit:
+                continue
+            if best is None or (lreq.rank, lreq.seq) > (
+                lanes[best].rank, lanes[best].seq
+            ):
+                best = li
+        return best
+
+    def _preempt(self, rt, state, lanes, vidx: int) -> bool:
+        """Park one decoding lane's KV through the conversation codec and
+        requeue the row (priority preemption). The parked pages are COPIES:
+        release_pages hands the originals back through the normal free
+        list, so the conservation census never sees a discrepancy. Returns
+        False when the lane can't be parked (dense state, codec mismatch) —
+        the caller stops hunting victims then."""
+        eng = self.engine
+        victim = lanes[vidx]
+        park_t0 = time.monotonic()
+        try:
+            # same validity rule as retirement parking: the decode step
+            # that emits token j writes the KV row for token j-1, so the
+            # last emitted token's row was never written
+            if len(victim.tokens) > 1:
+                history = np.concatenate(
+                    [victim.prompt, np.asarray(victim.tokens[:-1], np.int32)]
+                )
+            else:
+                history = victim.prompt
+            parked = rt.park_lane(state, vidx, history)
+        except Exception:  # noqa: BLE001 - lane left running on park failure
+            log.warning(
+                "preemption park failed for lane %d of %s",
+                vidx, self.model_id, exc_info=True,
+            )
+            return False
+        if parked is None:
+            return False
+        victim.preempt_parked = parked
+        victim.preemptions += 1
+        state.active[vidx] = False
+        state.release_pages(vidx)
+        d_st = getattr(state, "spec_draft", None)
+        if d_st is not None:
+            d_st.release_pages(vidx)
+        lanes[vidx] = None
+        with self.cv:
+            self.pending.append(victim)
+            if eng.metrics is not None:
+                eng.metrics.batcher_queue_depth.labels("generate").inc()
+        if eng.metrics is not None:
+            eng.metrics.gen_preemptions.labels(victim.priority).inc()
+        # flight-recorder phase note: every preemption decision leaves an
+        # auditable per-victim stamp (park cost attributed like a phase)
+        RECORDER.note_phases(
+            str(self.model_id), "continuous",
+            {"preempt_park": time.monotonic() - park_t0},
+        )
+        log.info(
+            "preempted lane %d of %s (class=%s, %d tokens emitted, "
+            "preemption %d/%d)",
+            vidx, self.model_id, victim.priority, len(victim.tokens),
+            victim.preemptions, eng.preempt_limit,
+        )
+        return True
+
     def _update_page_gauge(self, state) -> None:
         if state is not None and getattr(state, "paged", False):
             if hasattr(state, "page_stats"):
@@ -1514,6 +1837,7 @@ class ContinuousGenerateEngine:
         "_active": "_lock",
         "_pages": "_lock",
         "_closed": "_lock",
+        "_seq": "_lock",
     }
 
     def __init__(
@@ -1535,6 +1859,7 @@ class ContinuousGenerateEngine:
         conversation_kv_bytes: int | None = None,
         conversation_kv_disk_bytes: int | None = None,
         conversation_kv_dir: str | None = None,
+        prefill_chunk_tokens: int | None = None,
     ) -> None:
         self.runtime = runtime
         self.slots = max(1, int(slots))
@@ -1609,6 +1934,20 @@ class ContinuousGenerateEngine:
             )
         else:
             self.conversation_tier = None
+        # chunked prefill interleaving (ISSUE 19): None = defer to the
+        # runtime's ServingConfig (serving.prefill_chunk_tokens), 0 =
+        # explicitly off. Clamped UP to a pow2 so ONE compiled partial-
+        # prefill program serves every chunk of every prompt (the final
+        # chunk zero-pads into it).
+        pf = (
+            int(getattr(cfg, "prefill_chunk_tokens", 0) or 0)
+            if prefill_chunk_tokens is None else int(prefill_chunk_tokens)
+        )
+        self.prefill_chunk_tokens = _next_bucket(pf) if pf > 0 else 0
+        # priority preemption budget PER LANE: a row parked off its lane
+        # this many times decodes to completion afterwards no matter what
+        # class arrives — bounded starvation by construction
+        self.preempt_limit = 2
         self._lock = threading.Lock()
         self._scheds: dict[ModelId, _ContinuousScheduler] = {}
         self._active: dict[ModelId, int] = {}
@@ -1616,6 +1955,9 @@ class ContinuousGenerateEngine:
         # excludes index-only cached pages (true admission headroom)
         self._pages: dict[ModelId, tuple[int, int, int]] = {}
         self._closed = False
+        # engine-monotonic submit sequence — the FIFO half of admission's
+        # (rank, seq) order; preserved across preemption/crash requeues
+        self._seq = 0
         # observability (tests + bench)
         self.admitted = 0
         self.chunks = 0
@@ -1726,13 +2068,27 @@ class ContinuousGenerateEngine:
         seed: int | None = None,
         return_stats: bool = False,
         conversation_id: str | None = None,
+        priority: str = "normal",
+        on_token: Callable[[int], None] | None = None,
     ) -> np.ndarray:
         """Drop-in for GenerateCoalescer.generate: (rows, max_new_tokens)
         int32. A row that hit EOS early is zero-padded after it (the solo
         path has no EOS concept and always fills max_new_tokens — identical
         when the model declares no eos_id). ``return_stats`` additionally
         returns per-row timing dicts (ttft_s, admission_wait_s, tokens,
-        prefill_tokens) — the bench's streaming-TTFT surface.
+        prefill_tokens, priority, preemptions) — the bench's streaming-TTFT
+        surface.
+
+        ``priority`` ("high" | "normal" | "low") orders admission by class
+        then FIFO and arms preemption: a high-class arrival finding no free
+        pages parks the lowest-class decoding lane. Ignored on the solo
+        path (a solo dispatch has no queue to order).
+
+        ``on_token`` streams each emitted token the moment the scheduler
+        appends it (single-row requests only — multi-row token order is
+        undefined across lanes, so the callback is dropped). On the solo
+        path the full row is replayed through the callback after the
+        dispatch returns, so stream framing works identically there.
 
         ``conversation_id`` opts the request into the conversation KV tier
         (ISSUE 18): on retirement the row's decode state parks under the id,
@@ -1740,6 +2096,12 @@ class ContinuousGenerateEngine:
         prefill. Multi-row calls get per-row ids (``"{id}#r{row}"``) so rows
         never alias each other's parked state. A no-op when the tier is
         disabled (conversation_kv_bytes = 0), or on the solo path."""
+        pr = str(priority or "normal")
+        rank = _PRIORITY_RANKS.get(pr)
+        if rank is None:
+            raise ValueError(
+                f"unknown priority {priority!r} (expected high|normal|low)"
+            )
         ids = np.asarray(input_ids, np.int32)
         family = getattr(self.runtime, "family_of", lambda _m: None)(model_id)
         solo = (
@@ -1776,9 +2138,21 @@ class ContinuousGenerateEngine:
                 top_k=top_k,
                 seed=seed if seed is not None else secrets.randbits(31),
             )
+            if on_token is not None and out.ndim == 2 and out.shape[0] == 1:
+                # stream framing parity on the solo path: replay the row
+                # through the callback (all at once — a solo dispatch has
+                # no per-token boundary to hook)
+                for t in np.asarray(out)[0, :max_new_tokens].tolist():
+                    try:
+                        on_token(int(t))
+                    except Exception:  # noqa: BLE001 - client callback
+                        break
             return (out, None) if return_stats else out
 
         cid = str(conversation_id) if conversation_id else None
+        with self._lock:
+            seq0 = self._seq
+            self._seq += rows
         reqs = [
             _ContinuousReq(
                 prompt=ids[r, : lengths[r]].copy(),
@@ -1789,6 +2163,10 @@ class ContinuousGenerateEngine:
                     None if cid is None
                     else (cid if rows == 1 else f"{cid}#r{r}")
                 ),
+                priority=pr,
+                rank=rank,
+                seq=seq0 + r,
+                on_token=on_token if rows == 1 else None,
             )
             for r in range(rows)
         ]
@@ -1858,6 +2236,8 @@ class ContinuousGenerateEngine:
                     - r.enqueue_t,
                     "tokens": len(r.tokens[:max_new_tokens]),
                     "prefill_tokens": r.prefill_tokens,
+                    "priority": r.priority,
+                    "preemptions": r.preemptions,
                 }
                 for r in reqs
             ]

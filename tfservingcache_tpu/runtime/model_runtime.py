@@ -1698,6 +1698,16 @@ class TPUModelRuntime(BaseRuntime):
         eos = loaded.model_def.config.get("eos_id")
         return None if eos is None else int(eos)
 
+    def max_seq_of(self, model_id: ModelId) -> int | None:
+        """The model's max sequence length when its config declares one.
+        None when unset (non-LM families) or the model is not resident —
+        callers treat None as "cannot pre-validate", not as unlimited."""
+        loaded = self._resident.get(model_id, touch=False)
+        if loaded is None:
+            return None
+        ms = loaded.model_def.config.get("max_seq")
+        return None if ms is None else int(ms)
+
     def slot_decode_state(
         self,
         model_id: ModelId,
@@ -1960,6 +1970,76 @@ class TPUModelRuntime(BaseRuntime):
                 family=loaded.model_def.family,
             )
         return int(np.asarray(tok)[0]), pk, pv, hit is not None, last
+
+    # -- chunked prefill over the paged arena (ISSUE 19) ---------------------
+    def slot_prefill_chunk(  # static-bounded: cfg_key, chunk_size -- cfg_key is one value per resident model (model_def.config); chunk_size is one pow2 value per engine (serving.prefill_chunk_tokens)
+        self,
+        model_id: ModelId,
+        state: SlotDecodeState,
+        lane: int,
+        tokens: np.ndarray,   # (t,) this chunk's prompt tokens, t <= chunk_size
+        start: int,           # absolute position of tokens[0] in the prompt
+        chunk_size: int,      # STATIC padded chunk width (engine-clamped pow2)
+    ) -> np.ndarray:
+        """Write one prefill chunk into ``lane``'s reserved pages and return
+        the chunk's last REAL token logits as a (1, V) f32 host array. The
+        engine calls this once per scheduler boundary while the lane sits in
+        its PREFILLING state; on the final chunk it feeds the returned
+        logits to ``sample_first_token`` with the request's own seed. The
+        chunk is zero-padded up to ``chunk_size`` so one compiled program
+        serves every chunk (pad rows land past the prompt end inside the
+        reservation — or in the trash page past it — and are overwritten
+        write-before-read by decode)."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import (
+            _paged_prefill_chunk_jit,
+        )
+
+        loaded = self._resident.get(model_id)
+        if loaded is None:
+            raise ModelNotLoadedError(f"model {model_id} is not loaded")
+        cfg = loaded.model_def.config
+        cfg_key = tuple(sorted((k, v) for k, v in cfg.items()))
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        t = tokens.shape[0]
+        if not 0 < t <= chunk_size:
+            raise ValueError(
+                f"prefill chunk of {t} tokens outside (0, {chunk_size}]"
+            )
+        toks = np.zeros((1, chunk_size), np.int32)
+        toks[0, :t] = tokens
+        table_row = np.asarray(state.block_tables[lane:lane + 1], np.int32)
+        state.k, state.v, scales, last = _paged_prefill_chunk_jit(
+            loaded.params, state.k, state.v, state.scales, table_row,
+            toks, np.asarray([start], np.int32), np.asarray([t], np.int32),
+            cfg_key=cfg_key, family=loaded.model_def.family,
+            page_tokens=state.page_tokens, kernel=state.kernel,
+        )
+        if scales is not None:
+            state.scales = scales
+        return np.asarray(jax.device_get(last), np.float32)
+
+    def sample_first_token(
+        self,
+        last: np.ndarray,     # (1, V) f32 last-position logits
+        temperature: float,
+        top_k: int,
+        seed: int,
+    ) -> int:
+        """Sample a request's first token from prefill-final logits under
+        its own seed — the same split-then-sample the prefill jits do, so a
+        chunked prefill's first token matches a monolithic prefill of the
+        same prompt under the same seed."""
+        import jax
+
+        from tfservingcache_tpu.models.generation import _sample_logits_jit
+
+        tok = _sample_logits_jit(
+            np.asarray(last, np.float32), jax.random.PRNGKey(seed),
+            np.float32(temperature), np.int32(top_k),
+        )
+        return int(np.asarray(tok)[0])
 
     # -- shared-prefix KV over the paged arena (ISSUE 9) ---------------------
     def shared_prefix_plan(

@@ -188,6 +188,7 @@ class CacheNode:
                 conversation_kv_bytes=cfg.serving.conversation_kv_bytes,
                 conversation_kv_disk_bytes=cfg.serving.conversation_kv_disk_bytes,
                 conversation_kv_dir=cfg.serving.conversation_kv_dir,
+                prefill_chunk_tokens=cfg.serving.prefill_chunk_tokens,
             )
             # every group records into the SHARED Metrics registry (request/
             # error/latency counters must cover all groups); only the first

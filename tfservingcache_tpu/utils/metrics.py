@@ -294,6 +294,32 @@ class Metrics:
             "prefix-cache reuse)",
             ["engine", "kind"], registry=r,
         )
+        # SLO-aware engine (chunked prefill + priority classes + streaming):
+        # per-class preemption pressure, how many partial-prefill dispatches
+        # the interleaver issued, and streamed frames by protocol surface —
+        # the attribution trail for the slo_engine bench arms.
+        self.gen_preemptions = Counter(
+            "tpusc_gen_preemptions",
+            "Decoding lanes preempted by a higher-priority admission "
+            "(KV parked through the conversation codec, lane requeued, "
+            "resumed O(new tokens) when pages free), labeled by the "
+            "priority class of the VICTIM lane",
+            ["class"], registry=r,
+        )
+        self.gen_prefill_chunks = Counter(
+            "tpusc_gen_prefill_chunks",
+            "Partial-prefill dispatches issued by the continuous engine's "
+            "chunked-prefill interleaver (serving.prefill_chunk_tokens > 0); "
+            "one increment per chunk, so chunks/admission gauges how much "
+            "long-prompt prefill was broken up",
+            registry=r,
+        )
+        self.gen_stream_frames = Counter(
+            "tpusc_gen_stream_frames",
+            "Token frames written to streaming generate clients "
+            "(protocol = sse | grpc)",
+            ["protocol"], registry=r,
+        )
         self.gen_kv_arena_bytes = Gauge(
             "tpusc_gen_kv_arena_bytes",
             "Device bytes allocated to the paged KV arena (pages plus, "

@@ -40,6 +40,19 @@ else
     fi
 fi
 
+# -- gate 1b: SLO-engine tests must stay collectable --------------------------
+# tier-1 runs with --continue-on-collection-errors, which would silently
+# drop tests/test_slo_engine.py (streaming parity, preemption, chunked
+# prefill) from the suite on an import error; this gate makes that loud.
+note "slo-engine collect"
+if env JAX_PLATFORMS=cpu python -m pytest tests/test_slo_engine.py \
+    --collect-only -q -p no:cacheprovider >/dev/null; then
+    verdicts+=("slo-engine collect: OK")
+else
+    verdicts+=("slo-engine collect: FAIL")
+    fail=1
+fi
+
 # -- gate 2: tpusc-check (repo-native hazards; see LINT.md) -------------------
 note "tpusc-check"
 if python -m tools.tpusc_check tfservingcache_tpu; then

@@ -26,22 +26,26 @@ import sys
 METRICS = (
     "p50_ttft_ms", "p95_ttft_ms", "p99_ttft_ms", "tok_s", "wall_s",
     "completed", "lost", "recovered", "goodput", "cold_miss_rate",
-    "fault_injections",
+    "fault_injections", "preemptions",
 )
 
 CELL_COLS = (
     ("scenario", 14), ("fault", 17), ("requests", 4), ("completed", 4),
     ("lost", 4), ("recovered", 4), ("p50_ttft_ms", 8), ("p95_ttft_ms", 8),
     ("p99_ttft_ms", 8), ("tok_s", 7), ("goodput", 7),
-    ("cold_miss_rate", 6), ("fault_injections", 4), ("conservation_ok", 6),
+    ("cold_miss_rate", 6), ("fault_injections", 4), ("preemptions", 5),
+    ("conservation_ok", 6),
 )
 CELL_HDRS = {
     "requests": "req", "completed": "done", "lost": "lost",
     "recovered": "rcvd", "p50_ttft_ms": "p50 ms", "p95_ttft_ms": "p95 ms",
     "p99_ttft_ms": "p99 ms", "tok_s": "tok/s", "goodput": "goodpt",
     "cold_miss_rate": "miss", "fault_injections": "inj",
-    "conservation_ok": "census",
+    "preemptions": "prmpt", "conservation_ok": "census",
 }
+
+# render order for the per-priority-class pivot (ISSUE 19)
+_CLASS_ORDER = {"high": 0, "normal": 1, "low": 2}
 
 
 def _section(doc: dict) -> dict:
@@ -129,6 +133,61 @@ def render(doc: dict, out=None, metric: str = "p95_ttft_ms",
                 w(f"    error: {err}\n")
 
 
+def _unwrap(doc: dict) -> dict:
+    for key in ("parsed", "detail"):
+        if isinstance(doc.get(key), dict):
+            doc = doc[key]
+    return doc
+
+
+def render_classes(doc: dict, out=None) -> None:
+    """Per-priority-class TTFT pivot (ISSUE 19): one row per cell that
+    recorded ``ttft_ms_by_class`` (the slo_engine bench arms, plus any
+    scenario-lab cell that tagged its requests), one column per class.
+    Each cell shows ``p95 (n=count)`` — the SLO the class actually got,
+    not the population blend the headline p95 hides it in."""
+    out = sys.stdout if out is None else out
+    d = _unwrap(doc)
+    rows: list[tuple[str, dict]] = []
+    se = d.get("slo_engine")
+    if isinstance(se, dict):
+        for arm in se.get("arms") or []:
+            if arm.get("ttft_ms_by_class"):
+                rows.append(
+                    (f"slo_engine/{arm.get('name', '?')}",
+                     arm["ttft_ms_by_class"])
+                )
+    sl = d.get("scenario_lab")
+    if isinstance(sl, dict):
+        for r in sl.get("matrix") or []:
+            if r.get("ttft_ms_by_class"):
+                rows.append(
+                    (f"{r.get('scenario', '?')} x {r.get('fault', 'none')}",
+                     r["ttft_ms_by_class"])
+                )
+    if not rows:
+        raise SystemExit(
+            "no per-class TTFT data in this artifact "
+            "(run `python bench.py --only slo_engine` first)"
+        )
+    classes = sorted(
+        {c for _, m in rows for c in m},
+        key=lambda c: (_CLASS_ORDER.get(c, 9), c),
+    )
+    w = out.write
+    lw = max(24, max(len(label) for label, _ in rows) + 2)
+    w("p95 TTFT (ms) by priority class:\n")
+    w(f"{'cell':<{lw}}" + "".join(f"{c:>16}" for c in classes) + "\n")
+    for label, m in rows:
+        parts = []
+        for c in classes:
+            v = m.get(c)
+            parts.append(
+                f"{v['p95']:.0f} (n={v.get('n', '?')})" if v else "-"
+            )
+        w(f"{label:<{lw}}" + "".join(f"{p:>16}" for p in parts) + "\n")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="render scenario-lab SLO scorecards from a bench artifact"
@@ -138,9 +197,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="matrix cell metric (default p95_ttft_ms)")
     ap.add_argument("--cells", action="store_true",
                     help="also print every cell's full scorecard row")
+    ap.add_argument("--classes", action="store_true",
+                    help="per-priority-class p95 TTFT pivot (slo_engine "
+                         "arms + class-tagged lab cells)")
     args = ap.parse_args(argv)
     with open(args.artifact) as f:
         doc = json.load(f)
+    if args.classes:
+        render_classes(doc)
+        return 0
     render(doc, metric=args.metric, cells=args.cells)
     return 0
 
