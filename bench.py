@@ -164,7 +164,8 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
                 quantize: str | None = None, prefix_cache_bytes: int = 0,
                 cold_load_pipeline: bool | None = None,
                 compile_cache_dir: str | None = None,
-                host_tier_bytes: int = 0, metrics=None):
+                host_tier_bytes: int = 0, metrics=None,
+                mesh=None, serving_overrides: dict | None = None):
     from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
     from tfservingcache_tpu.cache.manager import CacheManager
     from tfservingcache_tpu.cache.providers.disk import DiskModelProvider
@@ -197,8 +198,10 @@ def _make_stack(family: str, tenants: int, tmp: str, hbm_gb: int = 8,
             ),
             **({} if cold_load_pipeline is None
                else {"cold_load_pipeline": cold_load_pipeline}),
+            **(serving_overrides or {}),
         ),
         metrics,
+        mesh=mesh,
         host_tier_bytes=host_tier_bytes,
     )
     manager = CacheManager(provider, cache, runtime, metrics)
@@ -298,7 +301,7 @@ SECTION_GROUPS = (
     "prefix_gen", "continuous_batching", "zoo_cold", "tenant_soak",
     "warm_tier", "peer_cold_start", "cold_pipeline", "paged_kv",
     "shared_prefix", "paged_kernel", "spec_continuous", "scenario_lab",
-    "conversation_kv", "slo_engine",
+    "conversation_kv", "slo_engine", "mesh_generate", "mesh_envelope",
 )
 
 
@@ -3463,6 +3466,246 @@ def collect_watcher_evidence() -> dict:
     return out
 
 
+def bench_mesh_generate(tmp: str, lm_config: dict) -> dict:
+    """Mesh fast path vs mesh coalesce fallback (ISSUE 20) at the SAME KV
+    budget on the same seeded Poisson schedule: both arms serve :generate
+    through a width-2 TP mesh runtime, one with serving.mesh_fast_path on
+    (continuous engine on the KV-head-sharded paged arena) and one with it
+    off (the pre-ISSUE-20 lockstep solo dispatch). Needs >= 2 local devices
+    — on a CPU host launch bench.py with
+    XLA_FLAGS=--xla_force_host_platform_device_count=2."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tfservingcache_tpu.parallel.mesh import make_mesh
+    from tfservingcache_tpu.runtime.batcher import (
+        ContinuousGenerateEngine,
+        GenerateCoalescer,
+    )
+    from tfservingcache_tpu.types import ModelId
+
+    if len(jax.local_devices()) < 2:
+        return {"skipped": "needs >= 2 local devices "
+                           "(set --xla_force_host_platform_device_count)"}
+
+    dense_slots, chunk, page_tokens = 4, 4, 16
+    max_seq = int(lm_config["max_seq"])
+    arena_pages = dense_slots * (max_seq // page_tokens)
+    head_dim = lm_config["d_model"] // lm_config["n_heads"]
+    bytes_per_token = (
+        2 * lm_config["n_layers"] * lm_config["n_kv_heads"] * head_dim
+        * np.dtype(lm_config.get("dtype", "float32")).itemsize
+    )
+
+    n_req = 24
+    vocab = lm_config["vocab_size"]
+    r = np.random.default_rng(42)
+    reqs = [
+        (
+            r.integers(0, vocab, int(r.integers(8, 17))).astype(np.int32),
+            int(r.integers(4, 33)),
+        )
+        for _ in range(n_req)
+    ]
+    arrivals = np.cumsum(r.exponential(0.02, n_req))
+
+    def replay(gen_fn) -> tuple[list, float]:
+        results: list = [None] * n_req
+        errors: list = []
+
+        def client(i):
+            prompt, max_new = reqs[i]
+            try:
+                results[i] = gen_fn(prompt, max_new)
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(f"{type(e).__name__}: {e}")
+
+        threads = []
+        start = time.perf_counter()
+        for i in range(n_req):
+            delay = arrivals[i] - (time.perf_counter() - start)
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=client, args=(i,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        if errors:
+            raise RuntimeError(f"{len(errors)} failed: {errors[:3]}")
+        return results, wall
+
+    def run_arm(name: str, fast_path: bool) -> dict:
+        mesh = make_mesh({"model": 2})
+        manager, runtime = _make_stack(
+            "transformer_lm", 1, os.path.join(tmp, name), config=lm_config,
+            mesh=mesh, serving_overrides={"mesh_fast_path": fast_path},
+        )
+        mid = ModelId("tenant0", 1)
+        manager.ensure_servable(mid)
+        # engine selection mirrors protocol/local_backend.py: the continuous
+        # engine on a fast-path mesh, the coalescer on a lockstep one
+        if fast_path:
+            eng = ContinuousGenerateEngine(
+                runtime, slots=8, chunk_tokens=chunk,
+                page_tokens=page_tokens, arena_pages=arena_pages,
+            )
+            warm = lambda: eng.generate(
+                mid, np.ones((1, 16), np.int32), max_new_tokens=4
+            )
+
+            def fn(prompt, max_new):
+                _, stats = eng.generate(
+                    mid, prompt[None], max_new_tokens=max_new,
+                    return_stats=True,
+                )
+                return stats[0]["ttft_s"], stats[0]["tokens"]
+        else:
+            eng = GenerateCoalescer(runtime, max_batch=8)
+            warm = lambda: eng.generate(
+                mid, np.ones((1, 16), np.int32), max_new_tokens=4
+            )
+
+            def fn(prompt, max_new):
+                # coalesce has no streaming: TTFT = whole-response wall
+                t0 = time.perf_counter()
+                eng.generate(mid, prompt[None], max_new_tokens=max_new)
+                return time.perf_counter() - t0, max_new
+        try:
+            warm()
+
+            results, wall = replay(fn)
+            ttfts = sorted(t for t, _ in results)
+            toks = sum(n for _, n in results)
+            return {
+                "mesh": runtime.mesh_topology(),
+                "engine": "continuous" if fast_path else "coalesce",
+                "p50_ttft_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+                "p95_ttft_ms": round(
+                    ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] * 1e3,
+                    1,
+                ),
+                "tok_s": round(toks / wall, 1),
+                "wall_s": round(wall, 2),
+                "tokens": toks,
+            }
+        finally:
+            if hasattr(eng, "close"):
+                eng.close()
+            manager.close()
+
+    out = {
+        "requests": n_req,
+        "kv_budget_bytes": arena_pages * page_tokens * int(bytes_per_token),
+        "page_tokens": page_tokens,
+        "arena_pages": arena_pages,
+        "fast_path": run_arm("fast", True),
+        "coalesce_fallback": run_arm("fallback", False),
+    }
+    out["tok_s_ratio"] = round(
+        out["fast_path"]["tok_s"]
+        / max(0.1, out["coalesce_fallback"]["tok_s"]), 2
+    )
+    return out
+
+
+def bench_mesh_envelope(tmp: str, lm_config: dict) -> dict:
+    """Cross-host collective envelope tax (VERDICT #7 / ISSUE 20): the SAME
+    width-2 TP group served in ONE process (sharded in-process fast path,
+    no envelope) vs TWO processes (every collective op ships a leader ->
+    follower HTTP envelope, parallel/multihost.py), ms/request by payload
+    size. Both arms are child processes over the identical CacheNode REST
+    path, so the delta is the process boundary, not the harness."""
+    import json as _json
+    import socket
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child = os.path.join(repo, "tools", "envelope_child.py")
+    store = os.path.join(tmp, "store")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run(
+        [
+            sys.executable, "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from tfservingcache_tpu.models.registry import export_artifact;"
+            f"export_artifact('transformer_lm', {store!r}, name='lm', "
+            f"version=1, config={lm_config!r})",
+        ],
+        check=True, env=env, cwd=repo, timeout=240,
+        stdout=subprocess.DEVNULL,
+    )
+
+    def free_ports(n: int) -> list[int]:
+        socks, ports = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return ports
+
+    def run_arm(nprocs: int, dpp: int) -> dict:
+        run_dir = os.path.join(tmp, f"arm{nprocs}p")
+        os.makedirs(run_dir, exist_ok=True)
+        ports = free_ports(1 + nprocs)
+        args = [str(dpp), str(ports[0]),
+                *[str(w) for w in ports[1:]], store, run_dir]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, child, str(pid), *args],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                env=env, cwd=repo,
+            )
+            for pid in range(nprocs)
+        ]
+        try:
+            out, _ = procs[0].communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            procs[0].kill()
+            out = procs[0].communicate()[0]
+            raise RuntimeError(f"leader timed out:\n{out[-2000:]}")
+        finally:
+            for p in procs[1:]:
+                p.terminate()
+                try:
+                    p.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                return _json.loads(line[len("RESULT "):])
+        raise RuntimeError(f"no RESULT line from leader:\n{out[-2000:]}")
+
+    single = run_arm(1, 2)   # one process, 2 virtual chips: no envelope
+    cross = run_arm(2, 1)    # two processes, 1 chip each: envelope per op
+    rows = []
+    for a, b in zip(single["rows"], cross["rows"]):
+        rows.append({
+            "prompt_tokens": a["prompt_tokens"],
+            "payload_bytes": a["payload_bytes"],
+            "single_process_ms": a["ms_per_request"],
+            "cross_process_ms": b["ms_per_request"],
+            "envelope_tax_ms": round(
+                b["ms_per_request"] - a["ms_per_request"], 2
+            ),
+        })
+    return {
+        "tp_width": 2,
+        "single_process": single,
+        "cross_process": cross,
+        "rows": rows,
+    }
+
+
 def run(args) -> dict:
     detail = PARTIAL  # sections land here live so the watchdog can salvage
     watcher = collect_watcher_evidence()
@@ -3818,6 +4061,24 @@ def run(args) -> dict:
                 )
         except Exception as e:  # noqa: BLE001
             detail["slo_engine"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("mesh_generate"):
+        try:
+            with _section("mesh_generate"):
+                detail["mesh_generate"] = bench_mesh_generate(
+                    os.path.join(tmp, "meshgenerate"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["mesh_generate"] = {"error": f"{type(e).__name__}: {e}"}
+
+    if want("mesh_envelope"):
+        try:
+            with _section("mesh_envelope"):
+                detail["mesh_envelope"] = bench_mesh_envelope(
+                    os.path.join(tmp, "meshenvelope"), lm_config
+                )
+        except Exception as e:  # noqa: BLE001
+            detail["mesh_envelope"] = {"error": f"{type(e).__name__}: {e}"}
 
     _close_stacks_beyond(0)  # idempotent final sweep; don't exit dirty
     for fam in ("mnist_cnn", "transformer_lm"):

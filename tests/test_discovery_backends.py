@@ -22,11 +22,14 @@ from tfservingcache_tpu.types import NodeInfo
 
 async def wait_for(queue: asyncio.Queue, predicate, timeout=5.0):
     """Drain membership snapshots until one satisfies ``predicate``."""
-    async with asyncio.timeout(timeout):
+    # asyncio.timeout is 3.11+; wait_for covers the 3.10 runners too
+    async def drain():
         while True:
             nodes = await queue.get()
             if predicate(nodes):
                 return nodes
+
+    return await asyncio.wait_for(drain(), timeout)
 
 
 def idents(nodes):
@@ -78,9 +81,11 @@ class FakeConsul:
 
 
 async def wait_until(cond, timeout=5.0):
-    async with asyncio.timeout(timeout):
+    async def spin():
         while not cond():
             await asyncio.sleep(0.01)
+
+    await asyncio.wait_for(spin(), timeout)
 
 
 async def serve_app(app):

@@ -33,7 +33,13 @@ def _free_ports(n: int) -> list[int]:
 import pytest
 
 
-@pytest.mark.parametrize("nprocs", [2, 4])
+# the 4-process arm multiplexes 16 gloo-collective participants over
+# however many cores the runner has — on small CI hosts that alone
+# outruns the leader's 600 s budget, so only the 2-process arm stays
+# tier-1 and the full 4×4 topology runs with the slow soaks
+@pytest.mark.parametrize(
+    "nprocs", [2, pytest.param(4, marks=pytest.mark.slow)]
+)
 def test_cross_host_group_serves_with_parity(tmp_path, nprocs):
     # export the artifact ONCE; both 'hosts' read the same store (in prod:
     # shared object storage), each keeps its own disk cache

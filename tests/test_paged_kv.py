@@ -341,10 +341,10 @@ def test_slot_state_allocated_once_under_race(tmp_path, monkeypatch):
     calls = []
     real = generation.init_cache
 
-    def slow_init(cfg, batch, max_len):
+    def slow_init(cfg, batch, max_len, mesh=None):
         calls.append(threading.get_ident())
         time.sleep(0.05)  # widen the race window the guard must close
-        return real(cfg, batch, max_len)
+        return real(cfg, batch, max_len, mesh=mesh)
 
     monkeypatch.setattr(generation, "init_cache", slow_init)
     states = [None] * 8

@@ -61,9 +61,19 @@ class ServingConfig:
     # packed H2D chunks, and dequantize leaves as they land, so cold
     # wall-clock ≈ max(stage) instead of Σ(stages). False restores the
     # strictly serialized stage-after-stage path (identical results, one
-    # flag away). Mesh/multi-process runtimes always run serialized — the
-    # lockstep device-op stream must not depend on host thread timing.
+    # flag away). Multi-PROCESS mesh runtimes always run serialized — the
+    # cross-host lockstep device-op stream must not depend on host thread
+    # timing; single-process meshes pipeline when mesh_fast_path is on.
     cold_load_pipeline: bool = True
+    # Mesh parity for the fast path (ISSUE 20): single-process mesh
+    # runtimes run the same pipelined cold load, host warm tier, packed
+    # adoption, and continuous/paged :generate engine as single-chip
+    # runtimes, with params and KV arenas sharded per the family's
+    # partition rules. False restores the pre-parity behavior (serialized
+    # loads, coalesce generate) — the A/B lever the mesh_generate bench
+    # section flips. Multi-process (cross-host) groups ignore the knob and
+    # stay serialized/coalesced: their device-op stream is lockstep.
+    mesh_fast_path: bool = True
     # Host buffers the chunk assembler may run ahead of the H2D stream
     # (bounded queue depth; each slot holds up to one ~256 MB packed chunk).
     cold_pipeline_buffer_depth: int = 2

@@ -40,16 +40,23 @@ warnings.filterwarnings(
 )
 
 
-def init_cache(cfg: dict, batch: int, max_len: int) -> dict:
+def init_cache(cfg: dict, batch: int, max_len: int, mesh=None) -> dict:
     """Preallocated per-layer K/V buffers. bf16 storage halves HBM traffic;
-    attention still accumulates in f32."""
+    attention still accumulates in f32. ``mesh`` commits the buffers to
+    KV-head shardings (parallel/sharding.kv_arena_shardings) so the slot
+    jits compile partitioned programs from day one."""
     n_kv = cfg["n_kv_heads"]
     head_dim = cfg["d_model"] // cfg["n_heads"]
     dtype = jnp.dtype(cfg["dtype"])
-    return {
+    cache = {
         "k": jnp.zeros((cfg["n_layers"], batch, n_kv, max_len, head_dim), dtype),
         "v": jnp.zeros((cfg["n_layers"], batch, n_kv, max_len, head_dim), dtype),
     }
+    if mesh is not None:
+        from tfservingcache_tpu.parallel.sharding import shard_kv_arena
+
+        cache = shard_kv_arena(cache, mesh)
+    return cache
 
 
 def _sample(logits, rng, temperature, top_k):
@@ -372,7 +379,7 @@ def _decode_chunk_jit(
 
 
 def init_paged_cache(cfg: dict, n_pages: int, page_tokens: int,
-                     arena_dtype: str = "") -> dict:
+                     arena_dtype: str = "", mesh=None) -> dict:
     """Preallocated paged KV arena shared by every lane of one model's
     continuous-decode state: fixed-size pages instead of per-lane
     ``max_seq`` rows, so HBM is sized by tokens in flight, not worst case.
@@ -386,22 +393,38 @@ def init_paged_cache(cfg: dict, n_pages: int, page_tokens: int,
     append never requantizes resident rows (a true per-page scale would
     force a read-modify-write of the whole page on every decode step).
     Payload bytes halve vs bf16 (head_dim int8 + 4 scale bytes per row vs
-    2*head_dim), which is where the extra admitted slots come from."""
+    2*head_dim), which is where the extra admitted slots come from.
+
+    ``mesh`` (ISSUE 20) commits the arena to KV-head shardings — each
+    shard holds ``(layers, n_pages, n_kv/axis, page_tokens, hd)`` — with
+    the int8 scale buffers sharded over the same KV-head axis (their dim
+    2), matching the layout GSPMD picks for the decode programs so the
+    arena-bytes accounting is stable from allocation onward. Block tables
+    and the free-list stay
+    host-side, so reserve/CoW/publish/census run unchanged on the sharded
+    arena; every jit that donates the arena round-trips the committed
+    layout, keeping donation effective."""
     n_kv = cfg["n_kv_heads"]
     head_dim = cfg["d_model"] // cfg["n_heads"]
     dtype = jnp.dtype(cfg["dtype"])
     shape = (cfg["n_layers"], n_pages, n_kv, page_tokens, head_dim)
     if arena_dtype == "int8":
         sshape = shape[:-1]
-        return {
+        cache = {
             "k": jnp.zeros(shape, jnp.int8),
             "v": jnp.zeros(shape, jnp.int8),
             "k_scale": jnp.zeros(sshape, jnp.float32),
             "v_scale": jnp.zeros(sshape, jnp.float32),
         }
-    if arena_dtype:
-        dtype = jnp.dtype(arena_dtype)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    else:
+        if arena_dtype:
+            dtype = jnp.dtype(arena_dtype)
+        cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if mesh is not None:
+        from tfservingcache_tpu.parallel.sharding import shard_kv_arena
+
+        cache = shard_kv_arena(cache, mesh)
+    return cache
 
 
 def _quantize_kv_rows(x):

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tfservingcache_tpu.parallel.mesh import compat_shard_map
+
 NEG_INF = -1e30
 
 
@@ -132,7 +134,7 @@ def ring_attention(
         raise ValueError(f"sequence {q.shape[2]} not divisible by {n_shards} ring shards")
     impl = _pick_impl(impl, q.shape[2] // n_shards, q.shape[3])
     spec = P(None, None, axis, None)
-    fn = jax.shard_map(
+    fn = compat_shard_map(
         functools.partial(_ring_shard_fn, axis=axis, n_shards=n_shards,
                           causal=causal, impl=impl, interpret=interpret),
         mesh=mesh,

@@ -79,6 +79,58 @@ def is_single_process(mesh: Mesh) -> bool:
     return all(d.process_index == me for d in mesh.devices.flat)
 
 
+# KV cache layouts place the KV-head axis at index 2 for BOTH the dense
+# slot array (layers, slots, n_kv, max_seq, head_dim) and the paged arena
+# (layers, n_pages, n_kv, page_tokens, head_dim) — one spec serves both.
+KV_HEAD_DIM = 2
+
+
+def kv_arena_spec(mesh: Mesh, n_kv: int, axis: str = "model") -> PartitionSpec:
+    """PartitionSpec for a KV cache/arena: partitioned over the KV-head
+    axis when the mesh has a >1 ``axis`` that divides ``n_kv`` (each shard
+    holds ``n_kv/axis`` heads' pages, mirroring the megatron-TP split of
+    wk/wv so a lane's K/V lands on the shard that computed it); replicated
+    otherwise — indivisible head counts degrade rather than fail."""
+    size = mesh.shape.get(axis, 1)
+    if size > 1 and n_kv % size == 0:
+        return PartitionSpec(None, None, axis, None, None)
+    return PartitionSpec()
+
+
+def kv_arena_shardings(mesh: Mesh, cache: Mapping[str, Any],
+                       axis: str = "model") -> dict[str, NamedSharding]:
+    """NamedShardings for an ``init_cache``/``init_paged_cache`` dict: the
+    ``k``/``v`` payload partitioned per ``kv_arena_spec``, and the int8
+    ``k_scale``/``v_scale`` buffers split over the SAME KV-head axis (their
+    dim 2) whenever the payload is — a scale row is only ever read next to
+    its page's head shard inside the decode jit, and committing the layout
+    GSPMD would pick anyway keeps the arena-bytes accounting stable from
+    allocation onward. Free-list/CoW/census bookkeeping stays host-side on
+    page COUNTS, so no consumer ever needs a cross-shard gather."""
+    spec = kv_arena_spec(mesh, int(cache["k"].shape[KV_HEAD_DIM]), axis)
+    payload = NamedSharding(mesh, spec)
+    scale = NamedSharding(
+        mesh,
+        PartitionSpec(None, None, axis) if axis in spec else PartitionSpec(),
+    )
+    return {
+        name: payload if name in ("k", "v") else scale for name in cache
+    }
+
+
+def shard_kv_arena(cache: Mapping[str, Any], mesh: Mesh,
+                   axis: str = "model") -> dict[str, Any]:
+    """Commit a freshly allocated KV cache dict to its mesh shardings, so
+    every generation jit that consumes it compiles a partitioned program
+    (donation preserved: the committed layout round-trips through the
+    donated-arena outputs)."""
+    shardings = kv_arena_shardings(mesh, cache, axis)
+    return {
+        name: jax.device_put(arr, shardings[name])
+        for name, arr in cache.items()
+    }
+
+
 def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
     if mesh.shape.get(axis, 1) > 1:
         return NamedSharding(mesh, PartitionSpec(axis))

@@ -124,10 +124,14 @@ class LocalServingBackend(ServingBackend):
         # serving.generate_engine=continuous replaces whichever generator the
         # batching knob picked with the slotted continuous-decode engine
         # (step-boundary admission / early retirement; runtime/batcher.py).
-        # Mesh runtimes keep the coalescer unconditionally: the slot engine's
-        # dynamic-index cache writes aren't sharding-annotated, same rule as
-        # serving.cold_load_pipeline.
-        if generate_engine == "continuous" and getattr(manager.runtime, "mesh", None) is None:
+        # Only LOCKSTEP runtimes (cross-process groups, or meshes with
+        # serving.mesh_fast_path off) keep the coalescer now: a
+        # single-process mesh runs the engine on its KV-head-sharded arena
+        # (ISSUE 20), same rule as serving.cold_load_pipeline.
+        if generate_engine == "continuous" and not getattr(
+            manager.runtime, "mesh_lockstep",
+            getattr(manager.runtime, "mesh", None) is not None,
+        ):
             from tfservingcache_tpu.runtime.batcher import ContinuousGenerateEngine
 
             self._generator = ContinuousGenerateEngine(

@@ -193,6 +193,17 @@ class RestServingServer:
                 model=request.query.get("model"),
             )
             snap["dumps"] = RECORDER.list_dumps()
+            # mesh topology stamp (ISSUE 20): engine numbers from a sharded
+            # arena are unreadable without the mesh that shaped them — same
+            # structural-stamp rule as kernel_active/platform in bench rows
+            rt = getattr(
+                getattr(self.backend, "manager", None), "runtime", None
+            )
+            topo_fn = getattr(rt, "mesh_topology", None)
+            if topo_fn is not None:
+                topo = topo_fn()
+                if topo is not None:
+                    snap["mesh"] = topo
             return web.json_response(snap)
         if path == "/monitoring/tenants":
             # per-tenant cost ledger (utils/accounting.py): ?top=k keeps the

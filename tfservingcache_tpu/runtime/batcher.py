@@ -668,12 +668,19 @@ class GenerateCoalescer:
             }
             if self.metrics is not None:
                 for ph, v in phases.items():
-                    self.metrics.request_phase.labels(ph, "coalesce").observe(v)
+                    # the coalescer predates priority classes: everything
+                    # it serves is class=normal
+                    self.metrics.observe_phase(ph, "coalesce", "normal", v)
             RECORDER.note_phases(
                 str(model_id), "coalesce", phases,
                 trace_id=ids_ctx[0] if ids_ctx else None,
             )
         TRACER.annotate_root(
+            priority="normal",  # the coalescer has no priority classes
+            # first token materializes when the whole batch lands
+            ttft_ms=round(
+                max(0.0, dev_t1 - min(sl.enqueue_t for sl in slots)) * 1e3, 3
+            ),
             phase_queue_ms=round(
                 max(0.0, dev_t0 - min(sl.enqueue_t for sl in slots)) * 1e3, 3
             ),
@@ -1826,9 +1833,12 @@ class ContinuousGenerateEngine:
 
     Scope mirrors the coalescer's exclusions: explicitly seeded requests
     (reproducible solo stream), non-transformer_lm families, malformed
-    params, and mesh runtimes (same rule as the cold-load pipeline: a
-    lockstep device-op stream must not depend on a host scheduler thread)
-    all fall through to ``runtime.generate``.
+    params, and LOCKSTEP mesh runtimes (``runtime.mesh_lockstep`` — a
+    cross-process group's device-op stream must not depend on a host
+    scheduler thread) all fall through to ``runtime.generate``. A
+    single-process mesh runs here on its KV-head-sharded arena (ISSUE 20),
+    greedy-parity-pinned against the single-device path by
+    tests/test_mesh_parity.py.
     """
 
     # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
@@ -2104,9 +2114,16 @@ class ContinuousGenerateEngine:
             )
         ids = np.asarray(input_ids, np.int32)
         family = getattr(self.runtime, "family_of", lambda _m: None)(model_id)
+        # mesh_lockstep (ISSUE 20): only CROSS-PROCESS groups (or meshes
+        # with serving.mesh_fast_path off) fall back to the solo/coalesce
+        # path now — a single-process mesh runs the continuous paged engine
+        # on its sharded arena
         solo = (
             seed is not None
-            or getattr(self.runtime, "mesh", None) is not None
+            or getattr(
+                self.runtime, "mesh_lockstep",
+                getattr(self.runtime, "mesh", None) is not None,
+            )
             or ids.ndim != 2
             or not ids.size
             or family != "transformer_lm"
@@ -2204,9 +2221,7 @@ class ContinuousGenerateEngine:
             }
             if self.metrics is not None:
                 for ph, v in phases.items():
-                    self.metrics.request_phase.labels(
-                        ph, "continuous"
-                    ).observe(v)
+                    self.metrics.observe_phase(ph, "continuous", r.priority, v)
             for ph, v in phases.items():
                 if v > worst.get(ph, -1.0):
                     worst[ph] = v
@@ -2225,8 +2240,18 @@ class ContinuousGenerateEngine:
             ),
             gen_prefix_hits=sum(1 for r in reqs if r.prefix_hit),
         )
+        # priority + TTFT stamped on the ROOT (not the request span) so
+        # /monitoring/traces and tools/slo_report.py --classes read the
+        # same per-class attribution the class-labeled phase histogram
+        # aggregates (ISSUE 20 satellite)
         TRACER.annotate_root(
-            **{f"phase_{ph}_ms": round(v * 1e3, 3) for ph, v in worst.items()}
+            priority=pr,
+            ttft_ms=round(
+                1e3 * max(
+                    (r.first_tok_t or r.enqueue_t) - r.enqueue_t for r in reqs
+                ), 3,
+            ),
+            **{f"phase_{ph}_ms": round(v * 1e3, 3) for ph, v in worst.items()},
         )
         if return_stats:
             stats = [

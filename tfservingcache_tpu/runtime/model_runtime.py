@@ -177,11 +177,145 @@ def _flatten_for_pack(host_params: Any):
     return outer, treedef, arrs, owner
 
 
+def _shard_chunk_plan(
+    arrs: list[np.ndarray], shard_list: list[Any]
+) -> list[tuple[Any, list[tuple[int, tuple]]]]:
+    """Chunk→shard segment map for a sharded packed transfer (ISSUE 20):
+    for every addressable device, the host-index slices of each flat leaf
+    that land on it (``NamedSharding.addressable_devices_indices_map``),
+    grouped per dtype into <=~256 MB chunks like ``_pack_plan``. Devices
+    iterate in id order and leaves in flat order, so the device-op stream
+    stays a pure function of (params, shardings) — the same determinism
+    contract as the unsharded plan. A replicated leaf contributes its full
+    slice to EVERY device (that is what replication costs on any path);
+    a partitioned leaf ships each device only its own shard — the
+    per-host/per-device shard filter."""
+    seg_by_dev: dict[Any, list[tuple[int, tuple]]] = {}
+    for i, (arr, sharding) in enumerate(zip(arrs, shard_list)):
+        for dev, idx in sharding.addressable_devices_indices_map(
+            arr.shape
+        ).items():
+            seg_by_dev.setdefault(dev, []).append((i, idx))
+    plan: list[tuple[Any, list[tuple[int, tuple]]]] = []
+    for dev in sorted(seg_by_dev, key=lambda d: d.id):
+        by_dtype: dict[str, list[tuple[int, tuple]]] = {}
+        for i, idx in seg_by_dev[dev]:
+            by_dtype.setdefault(arrs[i].dtype.str, []).append((i, idx))
+        for group in by_dtype.values():
+            chunk: list[tuple[int, tuple]] = []
+            chunk_bytes = 0
+            for i, idx in group:
+                chunk.append((i, idx))
+                chunk_bytes += arrs[i][idx].nbytes  # view: shape math only
+                if chunk_bytes >= _PACK_CHUNK_BYTES:
+                    plan.append((dev, chunk))
+                    chunk, chunk_bytes = [], 0
+            if chunk:
+                plan.append((dev, chunk))
+    return plan
+
+
+def packed_device_put_sharded(
+    host_params: Any,
+    shardings: Any,
+    buffer_depth: int = 2,
+) -> Any:
+    """Pipelined packed transfer of a pytree onto a (single-process) mesh:
+    ``shardings`` is a pytree of ``NamedSharding`` matching ``host_params``
+    (parallel/sharding.param_shardings). Each device receives only its own
+    shard bytes, packed per dtype into ~256 MB chunks assembled on a side
+    thread while the previous chunk's ``device_put`` streams — the same
+    double-buffering as the unsharded pipelined path, minus the on-device
+    dequant interleave (the mesh branch dequantizes on host first, because
+    partition rules name float leaves). The global arrays are assembled
+    from the landed per-device shards via
+    ``jax.make_array_from_single_device_arrays`` — committed shardings,
+    identical to what ``shard_params`` would have produced."""
+    import queue as queue_mod
+
+    import jax
+
+    outer, treedef, arrs, owner = _flatten_for_pack(host_params)
+    if any(role != "plain" for _, role in owner):
+        raise ValueError(
+            "sharded packed transfer requires host-dequantized leaves"
+        )
+    shard_list = jax.tree_util.tree_leaves(shardings)
+    if len(shard_list) != len(arrs):
+        raise ValueError("shardings tree does not match params tree")
+    if len(arrs) <= 2:
+        return jax.device_put(host_params, shardings)
+
+    plan = _shard_chunk_plan(arrs, shard_list)
+    done = object()
+    q: Any = queue_mod.Queue(maxsize=max(1, buffer_depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def assemble() -> None:
+        try:
+            for dev, chunk in plan:
+                parts = [
+                    np.ascontiguousarray(arrs[i][idx]).ravel()
+                    for i, idx in chunk
+                ]
+                flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                if not put((dev, chunk, flat)):
+                    return
+                del parts, flat
+            put(done)
+        except BaseException as e:  # noqa: BLE001 - re-raised by the consumer
+            put(e)
+
+    # flat idx -> {device: landed single-device shard}
+    shard_parts: dict[int, dict[Any, Any]] = {i: {} for i in range(len(arrs))}
+    worker = threading.Thread(
+        target=assemble, name="tpusc-shard-assembler", daemon=True
+    )
+    worker.start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            dev, chunk, flat = item
+            buf = jax.device_put(flat, dev)
+            parts = _split_fn(
+                flat.dtype.str, tuple(arrs[i][idx].shape for i, idx in chunk)
+            )(buf)
+            del buf, flat
+            for (i, _idx), p in zip(chunk, parts):
+                shard_parts[i][dev] = p
+    finally:
+        stop.set()
+        worker.join(timeout=5.0)
+
+    out: list[Any] = [None] * len(arrs)
+    for i, (arr, sharding) in enumerate(zip(arrs, shard_list)):
+        devs = sharding.addressable_devices_indices_map(arr.shape)
+        out[i] = jax.make_array_from_single_device_arrays(
+            arr.shape, sharding, [shard_parts[i][d] for d in devs]
+        )
+        shard_parts[i] = {}
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def packed_device_put_pipelined(
     host_params: Any,
     device: Any,
     buffer_depth: int = 2,
     capture: list | None = None,
+    shardings: Any | None = None,
 ) -> tuple[Any, float]:
     """Double-buffered packed transfer with interleaved on-device dequant.
 
@@ -205,6 +339,12 @@ def packed_device_put_pipelined(
     ships — the host-tier retention hook. Captured buffers are always OWNED
     (a single-element chunk's ``ravel`` is a view into the artifact's blob;
     retaining it would pin the whole file mapping, so views are copied).
+
+    ``shardings`` (ISSUE 20) is the shard filter: a pytree of
+    ``NamedSharding`` matching ``host_params`` routes the transfer through
+    ``packed_device_put_sharded`` — per-device shard chunks instead of
+    whole-leaf chunks, ``device`` ignored, dequant seconds 0.0 (the mesh
+    branch dequantizes on host before calling).
     """
     import queue as queue_mod
 
@@ -212,6 +352,13 @@ def packed_device_put_pipelined(
 
     from tfservingcache_tpu.models.registry import QuantLeaf
 
+    if shardings is not None:
+        return (
+            packed_device_put_sharded(
+                host_params, shardings, buffer_depth=buffer_depth
+            ),
+            0.0,
+        )
     outer, treedef, arrs, owner = _flatten_for_pack(host_params)
     if len(arrs) <= 2:
         params = jax.device_put(host_params, device)
@@ -458,6 +605,38 @@ def promote_packed_entry(entry: Any, device: Any) -> tuple[Any, float]:
     return jax.tree_util.tree_unflatten(entry.treedef, out_outer), dequant_s
 
 
+def unpack_entry_host(entry: Any) -> Any:
+    """Rebuild the HOST pytree from a ``PackedModelEntry``'s retained
+    chunks, expanding quant leaves on host (``dequant_host``). The sharded
+    promotion path (ISSUE 20) consumes this: its transfer re-slices
+    per-device segments out of whole leaves, so the whole-leaf chunk replay
+    that ``promote_packed_entry`` runs doesn't apply — and partition rules
+    name float leaves, so quant pairs must expand before sharding."""
+    import jax
+
+    from tfservingcache_tpu.models.registry import QuantLeaf
+
+    flat: list[Any] = [None] * len(entry.shapes)
+    for chunk, buf in entry.chunks:
+        off = 0
+        for i in chunk:
+            shape = entry.shapes[i]
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            flat[i] = buf[off:off + n].reshape(shape)
+            off += n
+    outer: list[Any] = [None] * entry.treedef.num_leaves
+    pending: dict[int, dict[str, Any]] = {}
+    for i, (oi, role) in enumerate(entry.owner):
+        if role == "plain":
+            outer[oi] = flat[i]
+        else:
+            pending.setdefault(oi, {})[role] = flat[i]
+    for oi, got in pending.items():
+        ql = QuantLeaf(got["q"], got["scale"], entry.quant_dtypes[oi])
+        outer[oi] = ql.dequant_host()
+    return jax.tree_util.tree_unflatten(entry.treedef, outer)
+
+
 @dataclass
 class LoadedModel:
     model_def: ModelDef
@@ -696,6 +875,28 @@ def _check_trash_unreachable(state: SlotDecodeState) -> None:
             )
 
 
+def _mesh_serialized(fn):
+    """Serialize device-program launches on mesh runtimes (ISSUE 20). A
+    partitioned program's launch enqueues a collective participant on every
+    mesh device; two threads interleaving launches can enqueue them in
+    DIFFERENT per-device orders — the CPU backend deadlocks its rendezvous
+    outright, and real device queues would cross-schedule the collectives.
+    Every dispatch entry point that an arbitrary thread may call (solo
+    generate/predict, the engine scheduler's slot_* ops) holds the
+    runtime-wide RLock for the duration of the call, so launches hit all
+    devices in one consistent order. Single-device runtimes skip the lock:
+    concurrent dispatch overlap there is free and safe."""
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        if self.mesh is None:
+            return fn(self, *args, **kwargs)
+        with self._mesh_dispatch_lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapped
+
+
 @lockchecked
 class TPUModelRuntime(BaseRuntime):
     # Guarded-field registry (tools/tpusc_check TPUSC001 + TPUSC_LOCKCHECK=1).
@@ -759,15 +960,19 @@ class TPUModelRuntime(BaseRuntime):
         self._adopted_lock = threading.Lock()
         # Host-RAM warm tier (cache/host_tier.py): packed transfer chunks +
         # executable handles of evicted models, so re-admission skips fetch
-        # and decode and pays only the H2D stream. Off-mesh only, like the
-        # cold pipeline: a chip group's device-op stream must not depend on
-        # which models happen to sit in one process's host tier. Demotions
-        # that must re-pack from the device copy run on the worker thread
-        # below — never in the evicting thread, which typically holds load
-        # or slot-map locks (see _on_evict).
+        # and decode and pays only the H2D stream. Single-process only
+        # (ISSUE 20 lifted the single-process-mesh gate, mesh_fast_path
+        # restores it): a CROSS-HOST group's device-op stream must not
+        # depend on which models happen to sit in one process's host tier,
+        # but a mesh owned entirely by this process has no peer to diverge
+        # from. Demotions that must re-pack from the device copy run on the
+        # worker thread below — never in the evicting thread, which
+        # typically holds load or slot-map locks (see _on_evict).
         self._host_tier = None
         self._demote_queue: queue.Queue | None = None
-        if host_tier_bytes > 0 and mesh is None:
+        if host_tier_bytes > 0 and (
+            mesh is None or (not self._mp_mesh and self.cfg.mesh_fast_path)
+        ):
             from tfservingcache_tpu.cache.host_tier import HostRamTier
 
             self._host_tier = HostRamTier(host_tier_bytes, metrics)
@@ -821,6 +1026,9 @@ class TPUModelRuntime(BaseRuntime):
         # _on_evict / reset_group_state / close all drop it.
         self._slot_states: dict[ModelId, SlotDecodeState] = {}
         self._slot_lock = threading.Lock()
+        # _mesh_serialized: one consistent per-device launch order for
+        # partitioned programs (held only when self.mesh is not None)
+        self._mesh_dispatch_lock = threading.RLock()
         # per-model once-guards for slot-state allocation (the array is big;
         # see slot_decode_state) — entries are popped once the state lands
         self._slot_init_guards: dict[ModelId, threading.Lock] = {}
@@ -858,10 +1066,11 @@ class TPUModelRuntime(BaseRuntime):
         wire (protocol/peer_transfer.py). The next ``_load`` of ``model_id``
         consumes it via the promotion path: same pipelined device_put the
         warm tier replays, skipping the artifact read-back. One-shot and
-        advisory: a mesh runtime drops it (group op streams must not depend
-        on per-process residency), and any promotion failure falls through
-        to the full disk load."""
-        if self.mesh is not None:
+        advisory: a MULTI-PROCESS mesh runtime drops it (cross-host group op
+        streams must not depend on per-process residency; a single-process
+        mesh promotes it through the sharded replay — ISSUE 20), and any
+        promotion failure falls through to the full disk load."""
+        if self.mesh_lockstep:
             return
         with self._adopted_lock:
             self._adopted[model_id] = entry
@@ -871,13 +1080,22 @@ class TPUModelRuntime(BaseRuntime):
         wire-adopted one can't. If the family executable is still resident
         this is a no-op (_promote shares it); otherwise build the same jit
         the disk path would so promotion installs a usable handle. Adoption
-        is gated off-mesh, so the plain (non-sharded-output) jit suffices."""
+        is gated to single-process runtimes (mesh_lockstep), so the plain
+        jit suffices — sharding comes from the committed params, and a
+        mesh-bound family gets its apply rebound here just like the disk
+        path would."""
         import jax
 
+        model_def = entry.model_def
+        apply_fn = (
+            model_def.bind_mesh(self.mesh)
+            if (self.mesh is not None and model_def.bind_mesh is not None)
+            else model_def.apply
+        )
         with self._jit_lock:
-            if entry.model_def.cache_key in self._jitted_by_key:
+            if model_def.cache_key in self._jitted_by_key:
                 return
-            entry.jitted = jax.jit(entry.model_def.apply)
+            entry.jitted = jax.jit(apply_fn)
 
     def _load(self, model: Model) -> str:
         mid = model.identifier
@@ -948,10 +1166,32 @@ class TPUModelRuntime(BaseRuntime):
         try:
             with TRACER.span("load", model=str(mid), tier="host") as load_span:
                 self._set_state(mid, ModelState.LOADING)
-                with TRACER.span("device_transfer", promoted=True):
-                    params, dequant_s = promote_packed_entry(
-                        entry, self._devices[0]
+                rules = entry.model_def.partition_rules
+                if self.mesh is not None and rules:
+                    # sharded replay (ISSUE 20): rebuild host leaves and
+                    # stream per-device shard chunks — the committed
+                    # shardings must match what the disk load produced, or
+                    # the revived executable would reshard on first call
+                    from tfservingcache_tpu.parallel.sharding import (
+                        param_shardings,
                     )
+
+                    with TRACER.span(
+                        "device_transfer", promoted=True, sharded=True
+                    ):
+                        host_params = unpack_entry_host(entry)
+                        params = packed_device_put_sharded(
+                            host_params,
+                            param_shardings(host_params, rules, self.mesh),
+                            buffer_depth=self.cfg.cold_pipeline_buffer_depth,
+                        )
+                        del host_params
+                    dequant_s = 0.0
+                else:
+                    with TRACER.span("device_transfer", promoted=True):
+                        params, dequant_s = promote_packed_entry(
+                            entry, self._devices[0]
+                        )
                 if dequant_s > 0:
                     TRACER.attach(
                         load_span, "device_dequant", dequant_s, overlapped=True
@@ -1055,17 +1295,41 @@ class TPUModelRuntime(BaseRuntime):
                 # inserts ICI collectives from the committed shardings.
                 # Quant leaves dequantize on HOST first — the rules name
                 # float leaves, not q/scale pairs.
-                from tfservingcache_tpu.parallel.sharding import shard_params
+                from tfservingcache_tpu.parallel.sharding import (
+                    param_shardings,
+                    shard_params,
+                )
 
                 if has_quant:
                     # its own stage: the int8 crossover comparison must see
                     # where the mesh path's dequant seconds go (host, here)
                     with TRACER.span("host_dequant"):
                         host_params = _dequantize_on_host(host_params)
-                with TRACER.span("device_transfer"):
-                    params = shard_params(
-                        host_params, model_def.partition_rules, self.mesh
-                    )
+                if pipelined:
+                    # per-device packed-chunk streaming (ISSUE 20): the
+                    # shard filter feeds each device only its own bytes,
+                    # chunk assembly overlapping the previous chunk's
+                    # device_put, and the AOT compile submitted above
+                    # overlaps the whole transfer — the same pipeline the
+                    # single-chip path runs, sharding-parameterized
+                    with TRACER.span(
+                        "device_transfer", pipelined=True, sharded=True
+                    ):
+                        params, _ = packed_device_put_pipelined(
+                            host_params,
+                            self._devices[0],
+                            buffer_depth=self.cfg.cold_pipeline_buffer_depth,
+                            shardings=param_shardings(
+                                host_params,
+                                model_def.partition_rules,
+                                self.mesh,
+                            ),
+                        )
+                else:
+                    with TRACER.span("device_transfer"):
+                        params = shard_params(
+                            host_params, model_def.partition_rules, self.mesh
+                        )
             elif pipelined:
                 # pipelined packed path: host chunk assembly on a side
                 # thread, device ops in the identical _pack_plan order on
@@ -1235,12 +1499,30 @@ class TPUModelRuntime(BaseRuntime):
 
     # -- pipelined cold load (compile-while-transfer) -----------------------
     @property
+    def mesh_lockstep(self) -> bool:
+        """True when this runtime's device-op stream must stay LOCKSTEP — a
+        pure function of the request sequence, never of host thread timing
+        or per-process residency — which is what actually forces the
+        serialized-load/coalesce-generate fallbacks. Before ISSUE 20 every
+        mesh runtime was lockstep; now only cross-process groups are (each
+        follower must replay the leader's exact op stream), plus any mesh
+        with ``serving.mesh_fast_path`` off (the A/B lever). Consumers:
+        adopt_packed_entry, the batcher's engine dispatch, and the local
+        backend's engine construction."""
+        return self.mesh is not None and (
+            self._mp_mesh or not self.cfg.mesh_fast_path
+        )
+
+    @property
     def cold_pipeline_enabled(self) -> bool:
-        """Pipelined cold loads run only off-mesh: a chip group's (above all
-        a cross-host group's) device-op stream must stay a pure function of
-        the load sequence, never of host thread timing, so mesh runtimes
-        keep the strictly serialized path regardless of the config flag."""
-        return bool(self.cfg.cold_load_pipeline) and self.mesh is None
+        """Pipelined cold loads run on single-chip AND single-process mesh
+        runtimes (ISSUE 20): the sharded branch streams per-device shard
+        chunks through ``packed_device_put_sharded``, feeding each device
+        only its own bytes. Lockstep (cross-host) groups keep the strictly
+        serialized path regardless of the config flag — their device-op
+        stream must stay a pure function of the load sequence, never of
+        host thread timing."""
+        return bool(self.cfg.cold_load_pipeline) and not self.mesh_lockstep
 
     def precompile_from_meta(self, meta: Mapping[str, Any]) -> None:
         """Start the family AOT compile from artifact metadata alone —
@@ -1314,8 +1596,30 @@ class TPUModelRuntime(BaseRuntime):
                 )
                 for name, spec in model_def.input_spec.items()
             }
+            apply_fn = model_def.apply
+            if self.mesh is not None and model_def.partition_rules:
+                # mesh AOT (ISSUE 20): lower against SHARDED abstract params
+                # — the executable the sharded pipelined load installs must
+                # accept the committed layouts the transfer produces, or
+                # _apply_fast would silently recompile via jit on first use
+                from tfservingcache_tpu.parallel.sharding import (
+                    param_shardings,
+                )
+
+                shardings = param_shardings(
+                    abs_params, model_def.partition_rules, self.mesh
+                )
+                abs_params = jax.tree_util.tree_map(
+                    lambda a, s: jax.ShapeDtypeStruct(
+                        a.shape, a.dtype, sharding=s
+                    ),
+                    abs_params,
+                    shardings,
+                )
+                if model_def.bind_mesh is not None:
+                    apply_fn = model_def.bind_mesh(self.mesh)
             compiled = (
-                jax.jit(model_def.apply).lower(abs_params, abs_inputs).compile()
+                jax.jit(apply_fn).lower(abs_params, abs_inputs).compile()
             )
         except BaseException:
             with self._aot_lock:
@@ -1389,6 +1693,7 @@ class TPUModelRuntime(BaseRuntime):
         return loaded.jitted(loaded.params, padded)
 
     # -- predict ------------------------------------------------------------
+    @_mesh_serialized
     def predict(
         self,
         model_id: ModelId,
@@ -1504,6 +1809,7 @@ class TPUModelRuntime(BaseRuntime):
             padded[name] = np.pad(arr, pad) if changed else arr
         return dyn_sizes, padded
 
+    @_mesh_serialized
     def generate(
         self,
         model_id: ModelId,
@@ -1708,6 +2014,7 @@ class TPUModelRuntime(BaseRuntime):
         ms = loaded.model_def.config.get("max_seq")
         return None if ms is None else int(ms)
 
+    @_mesh_serialized
     def slot_decode_state(
         self,
         model_id: ModelId,
@@ -1792,6 +2099,15 @@ class TPUModelRuntime(BaseRuntime):
             arena_dtype = str(getattr(self.cfg, "kv_arena_dtype", "") or "")
         if paged_kernel is None:
             paged_kernel = bool(getattr(self.cfg, "kv_paged_kernel", True))
+        # The fused Pallas decode kernel is single-chip-only (it indexes the
+        # whole KV-head axis locally); on a mesh the gather+einsum reference
+        # serves the sharded arena, pinned bitwise by tests/test_mesh_parity
+        if self.mesh is not None:
+            paged_kernel = False
+        # Sharded arena (ISSUE 20): pages partition over the KV-head axis on
+        # a fast-path mesh; a lockstep runtime never builds slot state (the
+        # batcher routes it to coalesce), but keep it dense-host-identical
+        arena_mesh = None if self.mesh_lockstep else self.mesh
         cfg = loaded.model_def.config
         max_seq = int(cfg["max_seq"])
         common = dict(
@@ -1826,7 +2142,9 @@ class TPUModelRuntime(BaseRuntime):
                     usable, (usable * hd * dense_item) // (hd + 4)
                 )
             # +1: page 0 is the trash page, permanently reserved
-            cache = init_paged_cache(cfg, usable + 1, page_tokens, arena_dtype)
+            cache = init_paged_cache(
+                cfg, usable + 1, page_tokens, arena_dtype, mesh=arena_mesh
+            )
             scales = None
             if "k_scale" in cache:
                 scales = {"k": cache["k_scale"], "v": cache["v_scale"]}
@@ -1861,7 +2179,7 @@ class TPUModelRuntime(BaseRuntime):
             )
             self._note_arena_bytes(st)
             return st
-        cache = init_cache(cfg, slots, max_seq)
+        cache = init_cache(cfg, slots, max_seq, mesh=arena_mesh)
         return SlotDecodeState(
             k=cache["k"], v=cache["v"],
             kernel=bool(paged_kernel), **common,
@@ -1875,11 +2193,33 @@ class TPUModelRuntime(BaseRuntime):
         practice — the engine keys slot state by model_id)."""
         if self.metrics is None or not state.page_tokens:
             return
+
+        def actual(arr: Any) -> int:
+            # Sharded arena (ISSUE 20): the gauge reports bytes actually
+            # ALLOCATED on this host's devices — the per-shard sum, not the
+            # logical array size (2x wrong on a 2-way KV-head split)
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                return sum(int(s.data.nbytes) for s in shards)
+            return int(arr.nbytes)
+
         label = state.arena_dtype or str(state.k.dtype)
-        nbytes = int(state.k.nbytes) + int(state.v.nbytes)
+        nbytes = actual(state.k) + actual(state.v)
         if state.scales is not None:
-            nbytes += sum(int(a.nbytes) for a in state.scales.values())
+            nbytes += sum(actual(a) for a in state.scales.values())
         self.metrics.gen_kv_arena_bytes.labels(dtype=label).set(nbytes)
+
+    def mesh_topology(self) -> dict | None:
+        """Structural stamp for /monitoring/engine and bench artifacts
+        (same rule as ``kernel_active``/``platform`` from BENCH_r09): a
+        number without its topology is unreadable later. None off-mesh."""
+        if self.mesh is None:
+            return None
+        return {
+            "mesh_devices": int(self.mesh.devices.size),
+            "mesh_axes": {k: int(v) for k, v in self.mesh.shape.items()},
+            "mesh_fast_path": not self.mesh_lockstep,
+        }
 
     def drop_slot_state(self, model_id: ModelId) -> None:
         with self._slot_lock:
@@ -1888,6 +2228,7 @@ class TPUModelRuntime(BaseRuntime):
             label = st.arena_dtype or str(st.k.dtype)
             self.metrics.gen_kv_arena_bytes.labels(dtype=label).set(0)
 
+    @_mesh_serialized
     def slot_prefill(
         self,
         model_id: ModelId,
@@ -1972,6 +2313,7 @@ class TPUModelRuntime(BaseRuntime):
         return int(np.asarray(tok)[0]), pk, pv, hit is not None, last
 
     # -- chunked prefill over the paged arena (ISSUE 19) ---------------------
+    @_mesh_serialized
     def slot_prefill_chunk(  # static-bounded: cfg_key, chunk_size -- cfg_key is one value per resident model (model_def.config); chunk_size is one pow2 value per engine (serving.prefill_chunk_tokens)
         self,
         model_id: ModelId,
@@ -2071,6 +2413,7 @@ class TPUModelRuntime(BaseRuntime):
             return None
         return plan
 
+    @_mesh_serialized
     def slot_prefill_shared(  # static-bounded: cfg_key -- one value per resident model (model_def.config)
         self,
         model_id: ModelId,
@@ -2138,6 +2481,7 @@ class TPUModelRuntime(BaseRuntime):
         )
         return int(np.asarray(tok)[0]), pk, pv, "shared", last
 
+    @_mesh_serialized
     def slot_cow(self, state: SlotDecodeState, lane: int, slot: int) -> None:
         """Copy-on-write: give ``lane`` a private copy of the page behind
         its block-table ``slot`` before its first write lands there. The
@@ -2236,6 +2580,7 @@ class TPUModelRuntime(BaseRuntime):
         return freed
 
     # -- conversation KV lifecycle (ISSUE 18) --------------------------------
+    @_mesh_serialized
     def park_lane(self, state: SlotDecodeState, lane: int,
                   history: np.ndarray) -> Any:
         """Export a retiring lane's live pages for conversation parking
@@ -2321,6 +2666,7 @@ class TPUModelRuntime(BaseRuntime):
             return None
         return covered, state.pages_needed(covered)
 
+    @_mesh_serialized
     def slot_resume_prefill(  # static-bounded: cfg_key -- one value per resident model (model_def.config)
         self,
         model_id: ModelId,
@@ -2387,6 +2733,7 @@ class TPUModelRuntime(BaseRuntime):
         )
         return int(np.asarray(tok)[0]), pk, pv, last
 
+    @_mesh_serialized
     def slot_admit(self, state: SlotDecodeState, idx: int, pk: Any, pv: Any,
                    base_tokens: int = 0) -> None:
         """Copy an admitted request's prefill K/V into slot lane ``idx``
@@ -2414,6 +2761,7 @@ class TPUModelRuntime(BaseRuntime):
             state.k, state.v, pk, pv, np.int32(idx)
         )
 
+    @_mesh_serialized
     def slot_decode_chunk(self, state: SlotDecodeState, chunk: int) -> np.ndarray:  # static-bounded: chunk -- engine clamps to a pow2 cover (batcher: min(chunk_tokens, _next_bucket(...)))
         """Advance every active lane by ``chunk`` decode steps in one
         dispatch; updates the state's device K/V and host tok/pos mirrors
@@ -2459,6 +2807,7 @@ class TPUModelRuntime(BaseRuntime):
         state.pos = np.array(jax.device_get(pos), dtype=np.int32)
         return np.asarray(jax.device_get(toks))
 
+    @_mesh_serialized
     def slot_attach_draft(self, state: SlotDecodeState, draft_id: ModelId,
                           spec_tokens: int = 4) -> SlotDecodeState:
         """Attach ``draft_id``'s decode state to ``state`` for in-engine
@@ -2518,6 +2867,7 @@ class TPUModelRuntime(BaseRuntime):
         state.spec_tokens = min(next_bucket(min(int(spec_tokens), 8)), 8)
         return d_st
 
+    @_mesh_serialized
     def slot_decode_spec_round(
         self, state: SlotDecodeState
     ) -> tuple[np.ndarray, np.ndarray]:

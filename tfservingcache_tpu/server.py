@@ -10,6 +10,7 @@ into every gRPC health server (main.go:35-42).
 from __future__ import annotations
 
 import asyncio
+import os
 import signal
 
 from tfservingcache_tpu.cache.disk_cache import ModelDiskCache
@@ -91,6 +92,15 @@ class CacheNode:
                 # jax.process_count() first would itself init the backend)
                 import jax
 
+                if (cfg.serving.platform or os.environ.get(
+                        "JAX_PLATFORMS", "")).startswith("cpu"):
+                    # the CPU backend only runs cross-process programs over
+                    # gloo collectives, and jax no longer defaults to them —
+                    # without this every partitioned op in a CPU group fails
+                    # with "Multiprocess computations aren't implemented"
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
                 try:
                     jax.distributed.initialize(
                         cfg.mesh.coordinator,

@@ -245,12 +245,21 @@ class Metrics:
         # prefill, decode steps, or response assembly. The same clocks land
         # as attrs on the request's trace root, so /monitoring/traces
         # answers "where did the time go" without cross-referencing.
+        # The per-priority `class` label rides the model_labels cardinality
+        # gate (ISSUE 20 satellite: 3 classes x 4 phases x 2 engines is
+        # cheap, but the flag keeps default deployments at the old arity);
+        # callers go through observe_phase so neither arity leaks out.
+        phase_labels = (
+            ["phase", "engine", "class"] if model_labels
+            else ["phase", "engine"]
+        )
         self.request_phase = Histogram(
             "tpusc_request_phase_seconds",
             "Per-request latency attribution by phase "
             "(phase=queue|prefill|decode|respond, "
-            "engine=continuous|coalesce)",
-            ["phase", "engine"], registry=r,
+            "engine=continuous|coalesce; class=high|normal|low "
+            "when model_labels is on)",
+            phase_labels, registry=r,
             buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25,
                      .5, 1, 2.5, 5, 10, 30),
         )
@@ -549,6 +558,17 @@ class Metrics:
             "(DRF-style dominant share in [0,1]; the noisy-neighbor signal)",
             ["model"], registry=r,
         )
+
+    def observe_phase(
+        self, phase: str, engine: str, cls: str, v: float
+    ) -> None:
+        """Observe one request-phase sample, routing the priority class to
+        the extra label only when ``model_labels`` enabled it at
+        construction — the one place that knows the histogram's arity."""
+        if self.model_labels:
+            self.request_phase.labels(phase, engine, cls or "normal").observe(v)
+        else:
+            self.request_phase.labels(phase, engine).observe(v)
 
     def model_label(self, name: str, version: int | str) -> str:
         if not self.model_labels:

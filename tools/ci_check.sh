@@ -53,6 +53,34 @@ else
     fail=1
 fi
 
+# -- gate 1c: mesh parity on a forced 2-device host ---------------------------
+# The sharded fast path (ISSUE 20) only exercises real partitioning when the
+# host exposes >1 device, which a default CPU runner does not. Pin the XLA
+# virtual-device count to exactly 2 and run the parity module — plus the
+# same collectability check as gate 1b, since an import error here would
+# otherwise vanish behind --continue-on-collection-errors.
+note "mesh parity (2 virtual devices)"
+if env JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_parity.py \
+    --collect-only -q -p no:cacheprovider >/dev/null; then
+    verdicts+=("mesh-parity collect: OK")
+else
+    verdicts+=("mesh-parity collect: FAIL")
+    fail=1
+fi
+if [ "$FAST" -eq 1 ]; then
+    verdicts+=("mesh-parity pytest: SKIPPED (--fast)")
+else
+    if timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+        python -m pytest tests/test_mesh_parity.py -q \
+        -p no:cacheprovider -p no:xdist -p no:randomly; then
+        verdicts+=("mesh-parity pytest: OK")
+    else
+        verdicts+=("mesh-parity pytest: FAIL")
+        fail=1
+    fi
+fi
+
 # -- gate 2: tpusc-check (repo-native hazards; see LINT.md) -------------------
 note "tpusc-check"
 if python -m tools.tpusc_check tfservingcache_tpu; then

@@ -1,0 +1,106 @@
+"""Child process for bench.py's ``mesh_envelope`` microbench (VERDICT #7 /
+ISSUE 20): one 'host' of a width-2 TP chip group, measuring the per-request
+cost of the cross-process collective envelope.
+
+Both arms run the SAME TP width through the same CacheNode REST path; the
+only variable is whether the group's two chips live in one process (no
+envelope — the sharded in-process fast path) or in two (every collective op
+ships a leader->follower HTTP envelope, parallel/multihost.py _broadcast).
+
+argv: process_id devices_per_process coordinator_port worker_port...
+      store_dir run_dir
+
+The leader (process 0) replays ``:generate`` at several prompt payload
+sizes and prints exactly one ``RESULT {json}`` line; followers print
+``FOLLOWER READY`` and serve group work until killed.
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+dpp = int(sys.argv[2])
+coord = sys.argv[3]
+worker_ports = sys.argv[4:-2]
+store, run_dir = sys.argv[-2], sys.argv[-1]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={dpp}"
+
+import asyncio  # noqa: E402
+import json  # noqa: E402
+import time  # noqa: E402
+
+PROMPT_TOKENS = (8, 32, 96)
+MAX_NEW = 8
+REQUESTS = 8  # per payload size, after one warmup
+
+
+async def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tfservingcache_tpu.config import Config
+
+    nprocs = len(worker_ports)
+    cfg = Config()
+    cfg.model_provider.base_dir = store
+    cfg.cache.base_dir = os.path.join(run_dir, f"cache_{pid}")
+    cfg.cache_node.rest_port = 0
+    cfg.cache_node.grpc_port = 0
+    cfg.serving.load_timeout_s = 240.0
+    cfg.mesh.chips_per_group = dpp * nprocs
+    if nprocs > 1:
+        cfg.mesh.coordinator = f"127.0.0.1:{coord}"
+        cfg.mesh.num_processes = nprocs
+        cfg.mesh.process_id = pid
+        cfg.mesh.worker_addrs = [f"127.0.0.1:{w}" for w in worker_ports]
+
+    from tfservingcache_tpu.server import CacheNode
+
+    node = CacheNode(cfg)
+    rest_port, _ = await node.start()
+
+    if pid != 0:
+        print("FOLLOWER READY", flush=True)
+        await asyncio.Event().wait()
+        return
+
+    import aiohttp
+
+    rt = node.groups[0].manager.runtime
+    topo = getattr(rt, "mesh_topology", lambda: None)()
+    out = {
+        "group_processes": max(1, nprocs),
+        "tp_width": dpp * max(1, nprocs),
+        "mesh": topo,
+        "rows": [],
+    }
+    async with aiohttp.ClientSession() as s:
+        base = f"http://127.0.0.1:{rest_port}/v1/models/lm/versions/1"
+        for plen in PROMPT_TOKENS:
+            ids = [[2 + (i % 100) for i in range(plen)]]
+            body = {"input_ids": ids, "max_new_tokens": MAX_NEW}
+            payload = len(json.dumps(body).encode())
+            async with s.post(f"{base}:generate", json=body) as r:
+                assert r.status == 200, await r.text()  # warm compile
+            t0 = time.perf_counter()
+            for _ in range(REQUESTS):
+                async with s.post(f"{base}:generate", json=body) as r:
+                    assert r.status == 200, await r.text()
+            ms = (time.perf_counter() - t0) / REQUESTS * 1e3
+            out["rows"].append({
+                "prompt_tokens": plen,
+                "payload_bytes": payload,
+                "ms_per_request": round(ms, 2),
+            })
+    print("RESULT " + json.dumps(out), flush=True)
+    # The node's grpc aio server and engine scheduler threads are non-daemon;
+    # a normal interpreter shutdown joins them forever and the parent's
+    # communicate() never sees EOF. The parent only needs the RESULT line,
+    # so skip teardown and let the OS reclaim everything.
+    sys.stdout.flush()
+    os._exit(0)
+
+
+asyncio.run(main())

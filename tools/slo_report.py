@@ -140,12 +140,37 @@ def _unwrap(doc: dict) -> dict:
     return doc
 
 
+def _classes_from_traces(traces: list) -> dict:
+    """Per-class TTFT map from a ``/monitoring/traces`` dump: generate
+    trace roots carry ``priority`` and ``ttft_ms`` attrs (stamped by the
+    batcher engines), so the live trace ring yields the same pivot the
+    bench arms record — the cross-check that the class-labeled
+    ``tpusc_request_phase_seconds`` histogram and the traces agree."""
+    samples: dict[str, list] = {}
+    for t in traces:
+        attrs = t.get("attrs") or {}
+        pr, ttft = attrs.get("priority"), attrs.get("ttft_ms")
+        if pr is not None and ttft is not None:
+            samples.setdefault(str(pr), []).append(float(ttft))
+    out = {}
+    for cls, vals in samples.items():
+        vals.sort()
+        out[cls] = {
+            "p50": vals[int(0.50 * (len(vals) - 1))],
+            "p95": vals[int(0.95 * (len(vals) - 1))],
+            "n": len(vals),
+        }
+    return out
+
+
 def render_classes(doc: dict, out=None) -> None:
     """Per-priority-class TTFT pivot (ISSUE 19): one row per cell that
     recorded ``ttft_ms_by_class`` (the slo_engine bench arms, plus any
     scenario-lab cell that tagged its requests), one column per class.
     Each cell shows ``p95 (n=count)`` — the SLO the class actually got,
-    not the population blend the headline p95 hides it in."""
+    not the population blend the headline p95 hides it in. A
+    ``/monitoring/traces`` dump (``{"traces": [...]}``) works too: the
+    pivot is derived from the roots' priority/ttft_ms attrs (ISSUE 20)."""
     out = sys.stdout if out is None else out
     d = _unwrap(doc)
     rows: list[tuple[str, dict]] = []
@@ -165,10 +190,15 @@ def render_classes(doc: dict, out=None) -> None:
                     (f"{r.get('scenario', '?')} x {r.get('fault', 'none')}",
                      r["ttft_ms_by_class"])
                 )
+    if isinstance(d.get("traces"), list):
+        by_class = _classes_from_traces(d["traces"])
+        if by_class:
+            rows.append(("traces", by_class))
     if not rows:
         raise SystemExit(
             "no per-class TTFT data in this artifact "
-            "(run `python bench.py --only slo_engine` first)"
+            "(run `python bench.py --only slo_engine` first, or dump "
+            "/monitoring/traces)"
         )
     classes = sorted(
         {c for _, m in rows for c in m},
